@@ -1,0 +1,18 @@
+"""qfedx_tpu — TPU-native privacy-preserving quantum federated learning.
+
+A brand-new framework with the capability surface of the QFedX reference
+(Nidszxh/QFedX; see SURVEY.md), rebuilt idiomatically for TPU:
+
+- ``ops``      — JAX statevector simulation engine (dense + device-sharded).
+- ``circuits`` — data encoders, variational ansatze, readout, quantum kernels.
+- ``data``     — dataset ingestion, preprocessing, federated partitioning.
+- ``models``   — VQC classifier + classical CNN baseline on one pytree API.
+- ``fed``      — SPMD federated runtime: clients as a mesh axis, FedAvg/FedProx
+                 as collectives, DP + secure aggregation on-device.
+- ``noise``    — quantum noise channels (depolarizing, damping, readout, shots).
+- ``parallel`` — mesh construction and sharding helpers.
+- ``run``      — configs, training CLI, checkpointing, metrics.
+- ``utils``    — pytree/serialization helpers.
+"""
+
+__version__ = "0.1.0"

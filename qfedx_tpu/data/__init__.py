@@ -1,0 +1,7 @@
+from qfedx_tpu.data.datasets import load_dataset  # noqa: F401
+from qfedx_tpu.data.partition import (  # noqa: F401
+    dirichlet_partition,
+    iid_partition,
+    pack_clients,
+)
+from qfedx_tpu.data.pipeline import preprocess  # noqa: F401

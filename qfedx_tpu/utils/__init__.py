@@ -1,0 +1,1 @@
+from qfedx_tpu.utils import trees  # noqa: F401

@@ -1,0 +1,67 @@
+"""Pytree utilities shared across the framework.
+
+The reference passes parameters around as torch ``state_dict`` objects
+(reference src/CFed/Classical_FL.py:64,66-81). Here all parameters are JAX
+pytrees, and the federated runtime needs a handful of whole-tree operations:
+flattening to a single vector (for ℓ2 clipping / secure-agg masks), global
+norms, and elementwise arithmetic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def tree_add(a: Pytree, b: Pytree) -> Pytree:
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_sub(a: Pytree, b: Pytree) -> Pytree:
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_scale(a: Pytree, s) -> Pytree:
+    return jax.tree.map(lambda x: x * s, a)
+
+
+def tree_zeros_like(a: Pytree) -> Pytree:
+    return jax.tree.map(jnp.zeros_like, a)
+
+
+def tree_map_with_path(fn: Callable, tree: Pytree) -> Pytree:
+    return jax.tree_util.tree_map_with_path(fn, tree)
+
+
+def global_norm(tree: Pytree) -> jax.Array:
+    """ℓ2 norm across the whole pytree (DP clipping operates on this,
+    per reference ROADMAP.md:50-51: "Clip Δθ to ℓ2 norm C")."""
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in leaves))
+
+
+def tree_size(tree: Pytree) -> int:
+    """Total number of scalar parameters in the tree (static)."""
+    return sum(int(x.size) for x in jax.tree.leaves(tree))
+
+
+def ravel(tree: Pytree):
+    """Flatten a pytree to a single 1-D vector plus an unravel function."""
+    return jax.flatten_util.ravel_pytree(tree)
+
+
+def tree_random_normal(key: jax.Array, tree: Pytree, dtype=None) -> Pytree:
+    """A pytree of iid N(0,1) samples with the same structure/shapes as
+    ``tree``. Each leaf gets an independent fold of ``key`` so the result is
+    deterministic in tree structure (used for DP noise and secure-agg masks)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    keys = jax.random.split(key, len(leaves))
+    out = [
+        jax.random.normal(k, x.shape, dtype or x.dtype)
+        for k, x in zip(keys, leaves)
+    ]
+    return jax.tree.unflatten(treedef, out)

@@ -1,0 +1,24 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+The reference simulates N federated clients in a single sequential process
+(reference src/CFed/Classical_FL.py:132-140); our framework maps clients onto
+a jax.sharding.Mesh axis. To test multi-chip semantics without TPU hardware,
+we force 8 host (CPU) devices — the same SPMD code then runs hostside
+(SURVEY.md §4: the TPU-native analog of the roadmap's "simulate N clients on
+one machine").
+
+This module must run before jax is imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,185 @@
+"""Data layer tests: IDX parsing, partitioners, preprocessing, packing."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from qfedx_tpu.data.datasets import load_dataset
+from qfedx_tpu.data.idx import read_idx, read_idx_images, read_idx_labels
+from qfedx_tpu.data.partition import (
+    dirichlet_partition,
+    iid_partition,
+    pack_clients,
+    partition_stats,
+)
+from qfedx_tpu.data.pipeline import (
+    PCATransform,
+    block_downsample,
+    filter_classes,
+    minmax_apply,
+    minmax_fit,
+    pool_features,
+    preprocess,
+    stratified_split,
+)
+
+
+def _write_idx(path, arr: np.ndarray):
+    arr = np.ascontiguousarray(arr, dtype=np.uint8)
+    header = struct.pack(">BBBB", 0, 0, 0x08, arr.ndim)
+    header += struct.pack(f">{arr.ndim}I", *arr.shape)
+    path.write_bytes(header + arr.tobytes())
+
+
+def test_idx_roundtrip(tmp_path):
+    imgs = np.random.default_rng(0).integers(0, 256, (5, 28, 28), dtype=np.uint8)
+    labels = np.arange(5, dtype=np.uint8)
+    _write_idx(tmp_path / "imgs", imgs)
+    _write_idx(tmp_path / "labels", labels)
+    np.testing.assert_array_equal(read_idx_images(tmp_path / "imgs"), imgs)
+    np.testing.assert_array_equal(read_idx_labels(tmp_path / "labels"), labels)
+
+
+def test_idx_rejects_garbage(tmp_path):
+    p = tmp_path / "bad"
+    p.write_bytes(b"\x01\x02\x03\x04\x05")
+    with pytest.raises(ValueError):
+        read_idx(p)
+
+
+def test_iid_partition_covers_all_disjoint():
+    parts = iid_partition(103, 4, seed=1)
+    allidx = np.concatenate(parts)
+    assert len(allidx) == 103
+    assert len(np.unique(allidx)) == 103
+    sizes = [len(p) for p in parts]
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_dirichlet_partition_covers_all_and_skews():
+    y = np.repeat(np.arange(5), 200)
+    parts = dirichlet_partition(y, 8, alpha=0.1, seed=3)
+    allidx = np.concatenate([p for p in parts if len(p)])
+    assert len(allidx) == 1000
+    assert len(np.unique(allidx)) == 1000
+    stats = partition_stats(y, parts, 5)
+    assert stats.sum() == 1000
+    # Low alpha should produce visible skew: some client/class cell near-empty
+    # while another holds a large share of that class.
+    per_class_max = stats.max(axis=0)
+    assert (per_class_max > 200 * 0.5).any()
+
+
+def test_dirichlet_high_alpha_balanced():
+    y = np.repeat(np.arange(4), 250)
+    parts = dirichlet_partition(y, 4, alpha=100.0, seed=0)
+    sizes = np.array([len(p) for p in parts])
+    assert sizes.min() > 150  # roughly balanced at high alpha
+
+
+def test_pack_clients_shapes_and_mask():
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+    y = np.arange(10)
+    parts = [np.array([0, 1, 2]), np.array([3]), np.array([], dtype=np.int64)]
+    cx, cy, mask = pack_clients(x, y, parts, pad_multiple=4)
+    assert cx.shape == (3, 4, 2) and cy.shape == (3, 4) and mask.shape == (3, 4)
+    np.testing.assert_array_equal(mask.sum(axis=1), [3, 1, 0])
+    np.testing.assert_array_equal(cx[0, :3], x[:3])
+    assert (cx[2] == 0).all()
+
+
+def test_filter_classes_remaps():
+    x = np.zeros((6, 2))
+    y = np.array([0, 5, 7, 5, 0, 7])
+    fx, fy = filter_classes(x, y, (5, 7))
+    assert len(fx) == 4
+    np.testing.assert_array_equal(fy, [0, 1, 0, 1])
+
+
+def test_stratified_split_fractions():
+    y = np.repeat(np.arange(3), 100)
+    x = np.arange(300)[:, None]
+    (rx, ry), (hx, hy) = stratified_split(x, y, 0.2, seed=0)
+    assert len(hx) == 60 and len(rx) == 240
+    for cls in range(3):
+        assert (hy == cls).sum() == 20
+
+
+def test_block_downsample_matches_manual():
+    img = np.arange(16, dtype=np.float32).reshape(1, 4, 4)
+    out = block_downsample(img, 2, 2)
+    expected = np.array([[[2.5, 4.5], [10.5, 12.5]]], dtype=np.float32)
+    np.testing.assert_allclose(out, expected)
+
+
+def test_block_downsample_non_integer_stride():
+    img = np.ones((2, 28, 28), dtype=np.float32)
+    out = block_downsample(img, 4, 4)
+    assert out.shape == (2, 4, 4)
+    np.testing.assert_allclose(out, 1.0, rtol=1e-6)
+
+
+def test_pool_features_chunks_and_pad():
+    v = np.arange(10, dtype=np.float32)
+    out = pool_features(v, 3)
+    # chunk=3: [0,1,2] [3,4,5] [6..9]
+    np.testing.assert_allclose(out, [1.0, 4.0, 7.5])
+    padded = pool_features(np.ones(2, dtype=np.float32), 4)
+    np.testing.assert_allclose(padded, [1, 1, 0, 0])
+
+
+def test_pca_transform_shapes_and_determinism():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(50, 30)).astype(np.float32)
+    pca = PCATransform.fit(x, 8)
+    z = pca(x)
+    assert z.shape == (50, 8)
+    z2 = PCATransform.fit(x, 8)(x)
+    np.testing.assert_allclose(z, z2, atol=1e-5)
+
+
+def test_minmax_fit_apply():
+    x = np.array([[0.0, 10.0], [1.0, 20.0]])
+    lo, hi = minmax_fit(x)
+    z = minmax_apply(x, lo, hi)
+    np.testing.assert_allclose(z, [[0, 0], [1, 1]])
+
+
+def test_load_dataset_synthetic_learnable_shapes():
+    spec, (tx, ty), (ex, ey) = load_dataset("mnist", synthetic_train=64, synthetic_test=32)
+    assert tx.shape == (64, 28, 28) and tx.dtype == np.uint8
+    assert ty.shape == (64,) and ey.shape == (32,)
+    spec_c, (cx, _), _ = load_dataset("cifar10", synthetic_train=16, synthetic_test=8)
+    assert cx.shape == (16, 32, 32, 3)
+    # Determinism
+    _, (tx2, ty2), _ = load_dataset("mnist", synthetic_train=64, synthetic_test=32)
+    np.testing.assert_array_equal(tx, tx2)
+    np.testing.assert_array_equal(ty, ty2)
+
+
+def test_load_dataset_reads_real_idx(tmp_path):
+    imgs = np.random.default_rng(0).integers(0, 256, (6, 28, 28), dtype=np.uint8)
+    labels = np.random.default_rng(1).integers(0, 10, 6).astype(np.uint8)
+    _write_idx(tmp_path / "train-images.idx3-ubyte", imgs)
+    _write_idx(tmp_path / "train-labels.idx1-ubyte", labels)
+    _write_idx(tmp_path / "t10k-images.idx3-ubyte", imgs[:2])
+    _write_idx(tmp_path / "t10k-labels.idx1-ubyte", labels[:2])
+    spec, (tx, ty), (ex, ey) = load_dataset("mnist", raw_folder=tmp_path)
+    np.testing.assert_array_equal(tx, imgs)
+    np.testing.assert_array_equal(ey, labels[:2])
+
+
+def test_preprocess_end_to_end_pca():
+    _, train, test = load_dataset("mnist", synthetic_train=256, synthetic_test=64)
+    pre = preprocess(train, test, classes=(0, 1), features="pca", n_features=4)
+    assert pre.num_classes == 2
+    assert pre.train[0].shape[1] == 4
+    assert pre.train[0].min() >= 0.0 and pre.train[0].max() <= 1.0
+    assert len(pre.val[0]) > 0 and len(pre.test[0]) > 0
+
+
+def test_preprocess_downsample_mode():
+    _, train, test = load_dataset("mnist", synthetic_train=128, synthetic_test=32)
+    pre = preprocess(train, test, features="downsample", n_features=16)
+    assert pre.train[0].shape[1] == 16
