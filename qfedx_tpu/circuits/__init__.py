@@ -1,0 +1,6 @@
+from qfedx_tpu.circuits.encoders import amplitude_encode, angle_encode  # noqa: F401
+from qfedx_tpu.circuits.ansatz import (  # noqa: F401
+    hardware_efficient,
+    init_ansatz_params,
+)
+from qfedx_tpu.circuits.readout import z_logits  # noqa: F401

@@ -1,0 +1,98 @@
+"""Variational ansatz library.
+
+Implements the reference's specified-but-unbuilt VQC module (reference
+ROADMAP.md:20-23,126-128): hardware-efficient ansatz = per-qubit RX(θ)/RZ(φ)
+rotations followed by a CNOT entangler ring, stacked L layers deep; plus the
+data-reuploading variant (BASELINE.md config 4) that re-applies a trainable
+affine re-encoding of the input between variational layers — the standard
+remedy for expressivity/barren-plateau issues at higher qubit counts
+(SURVEY.md §7.3.6).
+
+All functions are pure: (state or features, params) → state. Circuit
+structure (qubit count, depth) is static Python; parameters are traced, so
+`jax.grad` differentiates through the whole simulation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from qfedx_tpu.ops import gates
+from qfedx_tpu.ops.statevector import apply_gate, apply_gate_2q, product_state
+from qfedx_tpu.circuits.encoders import angle_amplitudes
+
+
+def init_ansatz_params(
+    key: jax.Array, n_qubits: int, n_layers: int, scale: float = 0.1
+) -> dict:
+    """Small-angle init — near-identity start helps trainability at depth
+    (barren-plateau mitigation; SURVEY.md §7.3.6)."""
+    k1, k2 = jax.random.split(key)
+    shape = (n_layers, n_qubits)
+    return {
+        "rx": scale * jax.random.normal(k1, shape, dtype=jnp.float32),
+        "rz": scale * jax.random.normal(k2, shape, dtype=jnp.float32),
+    }
+
+
+def _entangle_ring(state: jnp.ndarray, n_qubits: int) -> jnp.ndarray:
+    """CNOT ring: (0→1), (1→2), …, (n-1→0). Single qubit: no-op."""
+    if n_qubits < 2:
+        return state
+    for q in range(n_qubits - 1):
+        state = apply_gate_2q(state, gates.CNOT, q, q + 1)
+    if n_qubits > 2:
+        state = apply_gate_2q(state, gates.CNOT, n_qubits - 1, 0)
+    return state
+
+
+def ansatz_layer(state: jnp.ndarray, rx_angles, rz_angles) -> jnp.ndarray:
+    """One hardware-efficient layer: RX(θ_q), RZ(φ_q) ∀q, then CNOT ring."""
+    n = state.ndim
+    for q in range(n):
+        state = apply_gate(state, gates.rx(rx_angles[q]), q)
+        state = apply_gate(state, gates.rz(rz_angles[q]), q)
+    return _entangle_ring(state, n)
+
+
+def hardware_efficient(state: jnp.ndarray, params: dict) -> jnp.ndarray:
+    """L-layer hardware-efficient ansatz applied to an encoded state.
+
+    params: {"rx": (L, n), "rz": (L, n)} from `init_ansatz_params`.
+    """
+    n_layers = params["rx"].shape[0]
+    for layer in range(n_layers):
+        state = ansatz_layer(state, params["rx"][layer], params["rz"][layer])
+    return state
+
+
+def init_reuploading_params(
+    key: jax.Array, n_qubits: int, n_layers: int, scale: float = 0.1
+) -> dict:
+    """Adds per-layer trainable affine re-encoding (w·x + b) of the input."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    base = init_ansatz_params(k1, n_qubits, n_layers, scale)
+    base["enc_w"] = jnp.ones((n_layers, n_qubits), dtype=jnp.float32) + (
+        scale * jax.random.normal(k2, (n_layers, n_qubits), dtype=jnp.float32)
+    )
+    base["enc_b"] = scale * jax.random.normal(k3, (n_layers, n_qubits), dtype=jnp.float32)
+    return base
+
+
+def data_reuploading(features: jnp.ndarray, params: dict) -> jnp.ndarray:
+    """Data-reuploading circuit: [encode(w_l·x+b_l) → variational layer] × L.
+
+    ``features`` in [0,1], shape (n,); the first encoding starts from |0…0⟩
+    as a direct product state, later re-encodings are RY rotation banks.
+    """
+    n_layers, n_qubits = params["rx"].shape
+    for layer in range(n_layers):
+        angles = params["enc_w"][layer] * (features * jnp.pi) + params["enc_b"][layer]
+        if layer == 0:
+            state = product_state(angle_amplitudes(angles, "ry"))
+        else:
+            for q in range(n_qubits):
+                state = apply_gate(state, gates.ry(angles[q]), q)
+        state = ansatz_layer(state, params["rx"][layer], params["rz"][layer])
+    return state

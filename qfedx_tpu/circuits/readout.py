@@ -1,0 +1,32 @@
+"""Measurement readout → logits.
+
+Reference spec (ROADMAP.md:128): measure ⟨Z⟩ on readout qubit(s) and map to
+a logit ``a·⟨Z⟩ + b``. Multi-class: class c reads qubit c (requires
+num_classes ≤ n_qubits), each with its own trainable scale/bias.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from qfedx_tpu.ops.statevector import expect_z_all
+
+
+def init_readout_params(key: jax.Array, num_classes: int) -> dict:
+    del key  # deterministic init; key kept for API uniformity
+    return {
+        "scale": jnp.ones((num_classes,), dtype=jnp.float32),
+        "bias": jnp.zeros((num_classes,), dtype=jnp.float32),
+    }
+
+
+def z_logits(state: jnp.ndarray, params: dict) -> jnp.ndarray:
+    """logit_c = scale_c · ⟨Z_c⟩ + bias_c for c < num_classes."""
+    num_classes = params["scale"].shape[0]
+    if num_classes > state.ndim:
+        raise ValueError(
+            f"{num_classes} classes need ≥{num_classes} qubits, have {state.ndim}"
+        )
+    z = expect_z_all(state)[:num_classes]
+    return params["scale"] * z + params["bias"]

@@ -1,0 +1,8 @@
+from qfedx_tpu.ops import gates  # noqa: F401
+from qfedx_tpu.ops.statevector import (  # noqa: F401
+    apply_gate,
+    apply_gate_2q,
+    expect_z,
+    probabilities,
+    zero_state,
+)

@@ -1,0 +1,81 @@
+"""Quantum gate library as JAX arrays.
+
+The compute-path replacement for the reference's Qiskit circuit objects
+(reference src/QFed/qAngle.py:44-51 builds `QuantumCircuit`s gate by gate;
+src/QFed/qAmplitude.py:44-46 simulates them densely). Here a gate is just a
+complex64 matrix — (2,2) single-qubit, (2,2,2,2) two-qubit tensor — applied
+to a statevector by tensor contraction in `ops.statevector`. Rotation gates
+are traced functions of their (real) angle so the whole circuit is
+differentiable with `jax.grad` and fuses under XLA.
+
+Convention: qubit k is axis k of the state tensor of shape (2,)*n; for
+two-qubit tensors G[out1, out2, in1, in2], index 1 is the control where
+applicable.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+CDTYPE = jnp.complex64
+
+I2 = jnp.eye(2, dtype=CDTYPE)
+X = jnp.array([[0, 1], [1, 0]], dtype=CDTYPE)
+Y = jnp.array([[0, -1j], [1j, 0]], dtype=CDTYPE)
+Z = jnp.array([[1, 0], [0, -1]], dtype=CDTYPE)
+H = jnp.array([[1, 1], [1, -1]], dtype=CDTYPE) / jnp.sqrt(2).astype(CDTYPE)
+S = jnp.array([[1, 0], [0, 1j]], dtype=CDTYPE)
+T = jnp.array([[1, 0], [0, jnp.exp(1j * jnp.pi / 4)]], dtype=CDTYPE)
+
+# Two-qubit gates as (2,2,2,2) tensors: G[o1, o2, i1, i2], qubit 1 = control.
+CNOT = jnp.array(
+    [[[[1, 0], [0, 0]], [[0, 1], [0, 0]]], [[[0, 0], [0, 1]], [[0, 0], [1, 0]]]],
+    dtype=CDTYPE,
+)
+CZ = jnp.array(
+    [[[[1, 0], [0, 0]], [[0, 1], [0, 0]]], [[[0, 0], [1, 0]], [[0, 0], [0, -1]]]],
+    dtype=CDTYPE,
+)
+SWAP = jnp.array(
+    [[[[1, 0], [0, 0]], [[0, 0], [1, 0]]], [[[0, 1], [0, 0]], [[0, 0], [0, 1]]]],
+    dtype=CDTYPE,
+)
+
+
+def rx(theta) -> jnp.ndarray:
+    """RX(θ) = exp(-i θ X / 2); θ may be a traced scalar."""
+    c = jnp.cos(theta / 2).astype(CDTYPE)
+    s = (-1j * jnp.sin(theta / 2)).astype(CDTYPE)
+    return jnp.stack(
+        [jnp.stack([c, s]), jnp.stack([s, c])]
+    )
+
+
+def ry(theta) -> jnp.ndarray:
+    """RY(θ) = exp(-i θ Y / 2)."""
+    c = jnp.cos(theta / 2).astype(CDTYPE)
+    s = jnp.sin(theta / 2).astype(CDTYPE)
+    return jnp.stack([jnp.stack([c, -s]), jnp.stack([s, c])])
+
+
+def rz(theta) -> jnp.ndarray:
+    """RZ(θ) = exp(-i θ Z / 2)."""
+    t = jnp.asarray(theta).astype(CDTYPE)
+    e_neg = jnp.exp(-0.5j * t)
+    e_pos = jnp.exp(0.5j * t)
+    zero = jnp.zeros((), dtype=CDTYPE)
+    return jnp.stack([jnp.stack([e_neg, zero]), jnp.stack([zero, e_pos])])
+
+
+ROTATIONS = {"rx": rx, "ry": ry, "rz": rz}
+
+
+def crz(theta) -> jnp.ndarray:
+    """Controlled-RZ as a (2,2,2,2) tensor (control = first index pair)."""
+    g = jnp.zeros((2, 2, 2, 2), dtype=CDTYPE)
+    g = g.at[0, 0, 0, 0].set(1.0)
+    g = g.at[0, 1, 0, 1].set(1.0)
+    r = rz(theta)
+    g = g.at[1, 0, 1, 0].set(r[0, 0])
+    g = g.at[1, 1, 1, 1].set(r[1, 1])
+    return g

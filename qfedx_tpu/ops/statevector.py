@@ -1,0 +1,100 @@
+"""Dense statevector simulation engine (single device).
+
+The TPU-native replacement for the reference's entire quantum backend —
+Qiskit's `Statevector.from_instruction` one-liner (reference
+src/QFed/qAmplitude.py:44-46). Design (SURVEY.md §7.1.1):
+
+- State = complex64 tensor of shape ``(2,)*n``; qubit k is axis k.
+- Gates = small tensors contracted onto target axes with ``jnp.tensordot``
+  — XLA lowers these to batched matmuls on the MXU and fuses adjacent
+  elementwise work.
+- Batching over samples is ``jax.vmap``; everything is jit-compatible with
+  static circuit structure (qubit indices are Python ints at trace time).
+- Gradients flow through the simulation with ``jax.grad`` (the framework's
+  default differentiation; parameter-shift is kept as a cross-check in
+  ``circuits.gradients``, per reference ROADMAP.md:27,131-135).
+
+Memory is O(2^n) per state; the device-sharded engine in ``ops.sharded``
+extends this past single-chip HBM (reference ROADMAP.md:86 caps dense
+statevector at 20 qubits — sharding is how we hit that scale and beyond).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from qfedx_tpu.ops.gates import CDTYPE
+
+
+def zero_state(n_qubits: int) -> jnp.ndarray:
+    """|0...0⟩ as a (2,)*n tensor."""
+    state = jnp.zeros((2,) * n_qubits, dtype=CDTYPE)
+    return state.reshape(-1).at[0].set(1.0).reshape((2,) * n_qubits)
+
+
+def product_state(amps: jnp.ndarray) -> jnp.ndarray:
+    """Tensor product of per-qubit 2-vectors; amps shape (n, 2) → (2,)*n.
+
+    Used by the angle encoder: a bank of single-qubit rotations on |0⟩ is a
+    product state, so we build it directly in O(2^n) *memory writes* with no
+    sequential gate applications at all.
+    """
+    n = amps.shape[0]
+    state = amps[0].astype(CDTYPE)
+    for k in range(1, n):
+        state = jnp.tensordot(state, amps[k].astype(CDTYPE), axes=0)
+    return state
+
+
+def apply_gate(state: jnp.ndarray, gate: jnp.ndarray, qubit: int) -> jnp.ndarray:
+    """Apply a (2,2) gate to axis ``qubit`` of a (2,)*n state."""
+    out = jnp.tensordot(gate, state, axes=((1,), (qubit,)))
+    return jnp.moveaxis(out, 0, qubit)
+
+
+def apply_gate_2q(
+    state: jnp.ndarray, gate: jnp.ndarray, q1: int, q2: int
+) -> jnp.ndarray:
+    """Apply a (2,2,2,2) gate tensor G[o1,o2,i1,i2] to axes (q1, q2)."""
+    out = jnp.tensordot(gate, state, axes=((2, 3), (q1, q2)))
+    return jnp.moveaxis(out, (0, 1), (q1, q2))
+
+
+def probabilities(state: jnp.ndarray) -> jnp.ndarray:
+    """|ψ|² flattened to (2^n,) in big-endian qubit order."""
+    return jnp.square(jnp.abs(state)).reshape(-1)
+
+
+def expect_z(state: jnp.ndarray, qubit: int) -> jnp.ndarray:
+    """⟨Z_qubit⟩ = P(qubit=0) − P(qubit=1), real scalar.
+
+    The readout primitive: reference ROADMAP.md:128 maps ⟨Z⟩ → logit.
+    """
+    probs = jnp.square(jnp.abs(state))
+    n = state.ndim
+    z = jnp.array([1.0, -1.0], dtype=probs.dtype).reshape(
+        (1,) * qubit + (2,) + (1,) * (n - qubit - 1)
+    )
+    return jnp.sum(probs * z)
+
+
+def expect_z_all(state: jnp.ndarray) -> jnp.ndarray:
+    """⟨Z_k⟩ for every qubit k at once, shape (n,).
+
+    One pass over |ψ|² instead of n separate reductions — the hot readout
+    path when logits use several qubits.
+    """
+    probs = jnp.square(jnp.abs(state))
+    n = state.ndim
+    out = []
+    for k in range(n):
+        axes = tuple(i for i in range(n) if i != k)
+        marg = jnp.sum(probs, axis=axes)
+        out.append(marg[0] - marg[1])
+    return jnp.stack(out)
+
+
+def fidelity(state_a: jnp.ndarray, state_b: jnp.ndarray) -> jnp.ndarray:
+    """|⟨a|b⟩|² — the quantum-kernel primitive (BASELINE.md config 5)."""
+    overlap = jnp.sum(jnp.conj(state_a) * state_b)
+    return jnp.square(jnp.abs(overlap))

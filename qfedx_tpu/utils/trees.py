@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Any, Callable
 
 import jax
+import jax.flatten_util  # noqa: F401  (registers jax.flatten_util.ravel_pytree)
 import jax.numpy as jnp
 
 Pytree = Any

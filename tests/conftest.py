@@ -7,12 +7,16 @@ we force 8 host (CPU) devices — the same SPMD code then runs hostside
 (SURVEY.md §4: the TPU-native analog of the roadmap's "simulate N clients on
 one machine").
 
-This module must run before jax is imported anywhere in the test process.
+Note: the environment may import jax at interpreter startup (sitecustomize)
+with JAX_PLATFORMS pointing at a tunneled TPU, so setting env vars here can
+be too late for the env-var path. The backend itself initializes lazily, so
+``jax.config.update`` before first device use still selects the platform,
+and XLA_FLAGS is read at backend init for the host device count.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,4 +25,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+assert len(jax.devices()) == 8, (
+    "tests require the 8-device virtual CPU platform; got "
+    f"{jax.devices()} — was a backend already initialized before conftest?"
+)

@@ -1,0 +1,151 @@
+"""Encoders, ansatze, readout, and the parameter-shift ≡ jax.grad check
+(the reference roadmap's own Phase-1 verification, ROADMAP.md:27)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from qfedx_tpu.circuits.ansatz import (
+    data_reuploading,
+    hardware_efficient,
+    init_ansatz_params,
+    init_reuploading_params,
+)
+from qfedx_tpu.circuits.encoders import amplitude_encode, angle_encode
+from qfedx_tpu.circuits.gradients import param_shift_grad, param_shift_grad_pytree
+from qfedx_tpu.circuits.readout import init_readout_params, z_logits
+from qfedx_tpu.ops import gates
+from qfedx_tpu.ops.statevector import apply_gate, expect_z, probabilities, zero_state
+
+
+def test_angle_encode_matches_gate_application():
+    feats = jnp.array([0.0, 0.25, 0.5, 1.0])
+    state = angle_encode(feats)
+    seq = zero_state(4)
+    for q in range(4):
+        seq = apply_gate(seq, gates.ry(feats[q] * jnp.pi), q)
+    np.testing.assert_allclose(np.asarray(state), np.asarray(seq), atol=1e-6)
+    # f=0 → |0⟩ (⟨Z⟩=1), f=1 → |1⟩ (⟨Z⟩=-1), f=0.5 → equator (⟨Z⟩=0)
+    assert float(expect_z(state, 0)) == pytest.approx(1.0, abs=1e-6)
+    assert float(expect_z(state, 3)) == pytest.approx(-1.0, abs=1e-6)
+    assert float(expect_z(state, 2)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_angle_encode_bases():
+    feats = jnp.array([0.3, 0.7])
+    for basis in ("rx", "ry", "rz"):
+        state = angle_encode(feats, basis=basis)
+        assert float(jnp.sum(probabilities(state))) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_amplitude_encode_normalizes():
+    x = jnp.array([3.0, 0.0, 0.0, 4.0])
+    state = amplitude_encode(x)
+    np.testing.assert_allclose(
+        np.asarray(state.reshape(-1)), [0.6, 0, 0, 0.8], atol=1e-6
+    )
+
+
+def test_amplitude_encode_zero_fallback_uniform():
+    state = amplitude_encode(jnp.zeros(8))
+    np.testing.assert_allclose(
+        np.asarray(probabilities(state)), np.full(8, 1 / 8), atol=1e-6
+    )
+
+
+def test_amplitude_encode_rejects_non_power_of_two():
+    with pytest.raises(ValueError):
+        amplitude_encode(jnp.ones(6))
+
+
+def test_amplitude_encode_vmaps():
+    xs = jnp.eye(4)
+    states = jax.vmap(amplitude_encode)(xs)
+    assert states.shape == (4, 2, 2)
+
+
+def test_hardware_efficient_unit_norm_and_entangles():
+    key = jax.random.PRNGKey(0)
+    params = init_ansatz_params(key, 4, 2, scale=1.0)
+    state = hardware_efficient(angle_encode(jnp.array([0.1, 0.5, 0.9, 0.4])), params)
+    assert float(jnp.sum(probabilities(state))) == pytest.approx(1.0, abs=1e-5)
+    # Entangled in general: state should not factor as a product — check via
+    # purity of the 1-qubit reduced density matrix < 1.
+    full = np.asarray(state).reshape(2, 8)
+    rho = full @ full.conj().T
+    purity = float(np.real(np.trace(rho @ rho)))
+    assert purity < 0.999
+
+
+def test_data_reuploading_runs_and_depends_on_input():
+    key = jax.random.PRNGKey(1)
+    params = init_reuploading_params(key, 3, 2)
+    s1 = data_reuploading(jnp.array([0.1, 0.2, 0.3]), params)
+    s2 = data_reuploading(jnp.array([0.9, 0.8, 0.7]), params)
+    assert float(jnp.sum(probabilities(s1))) == pytest.approx(1.0, abs=1e-5)
+    assert not np.allclose(np.asarray(s1), np.asarray(s2), atol=1e-3)
+
+
+def test_readout_shapes_and_bounds():
+    key = jax.random.PRNGKey(2)
+    params = init_readout_params(key, 3)
+    state = angle_encode(jnp.array([0.2, 0.5, 0.8, 0.1]))
+    logits = z_logits(state, params)
+    assert logits.shape == (3,)
+    # with unit scale / zero bias, logits are ⟨Z⟩ ∈ [-1, 1]
+    assert np.all(np.abs(np.asarray(logits)) <= 1.0 + 1e-6)
+
+
+def test_readout_rejects_too_many_classes():
+    params = init_readout_params(jax.random.PRNGKey(0), 5)
+    with pytest.raises(ValueError):
+        z_logits(angle_encode(jnp.array([0.1, 0.2])), params)
+
+
+def _expectation_fn(n_qubits=3, n_layers=2):
+    """⟨Z_0⟩ of an encoded + variational circuit as fn of flat params."""
+    feats = jnp.array([0.15, 0.62, 0.87])
+
+    def fn(params):
+        state = hardware_efficient(angle_encode(feats), params)
+        return expect_z(state, 0)
+
+    params = init_ansatz_params(jax.random.PRNGKey(3), n_qubits, n_layers, scale=0.7)
+    return fn, params
+
+
+def test_parameter_shift_matches_jax_grad():
+    """The Phase-1 check (ROADMAP.md:27): parameter-shift ≡ adjoint (here:
+    reverse-mode AD through the simulator) within tolerance."""
+    fn, params = _expectation_fn()
+    ad_grad = jax.grad(fn)(params)
+    ps_grad = param_shift_grad_pytree(fn, params)
+    for k in ad_grad:
+        np.testing.assert_allclose(
+            np.asarray(ad_grad[k]), np.asarray(ps_grad[k]), atol=2e-4
+        )
+
+
+def test_parameter_shift_flat_vector():
+    def fn(theta):
+        state = zero_state(1)
+        state = apply_gate(state, gates.ry(theta[0]), 0)
+        return expect_z(state, 0)
+
+    theta = jnp.array([0.4])
+    # d/dθ cos(θ) = -sin(θ)
+    got = param_shift_grad(fn, theta)
+    np.testing.assert_allclose(np.asarray(got), [-np.sin(0.4)], atol=1e-5)
+
+
+def test_grad_through_reuploading_circuit():
+    feats = jnp.array([0.2, 0.6, 0.4])
+    params = init_reuploading_params(jax.random.PRNGKey(4), 3, 2)
+
+    def loss(p):
+        return expect_z(data_reuploading(feats, p), 0)
+
+    g = jax.grad(loss)(params)
+    total = sum(float(jnp.sum(jnp.abs(v))) for v in jax.tree.leaves(g))
+    assert np.isfinite(total) and total > 0

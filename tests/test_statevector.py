@@ -1,0 +1,161 @@
+"""Statevector engine correctness vs. dense linear algebra ground truth."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from qfedx_tpu.ops import gates
+from qfedx_tpu.ops.statevector import (
+    apply_gate,
+    apply_gate_2q,
+    expect_z,
+    expect_z_all,
+    fidelity,
+    probabilities,
+    product_state,
+    zero_state,
+)
+
+
+def dense_1q(gate: np.ndarray, qubit: int, n: int) -> np.ndarray:
+    """Full 2^n × 2^n matrix for a 1-qubit gate (ground truth via kron)."""
+    ops = [np.eye(2)] * n
+    ops[qubit] = np.asarray(gate)
+    out = ops[0]
+    for m in ops[1:]:
+        out = np.kron(out, m)
+    return out
+
+
+def rand_state(n, seed=0):
+    rng = np.random.default_rng(seed)
+    v = rng.normal(size=2**n) + 1j * rng.normal(size=2**n)
+    v /= np.linalg.norm(v)
+    return v.astype(np.complex64)
+
+
+def test_rotation_gates_match_closed_form():
+    theta = 0.7321
+    np.testing.assert_allclose(
+        np.asarray(gates.rx(theta)),
+        np.cos(theta / 2) * np.eye(2) - 1j * np.sin(theta / 2) * np.array([[0, 1], [1, 0]]),
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(gates.ry(theta)),
+        [[np.cos(theta / 2), -np.sin(theta / 2)], [np.sin(theta / 2), np.cos(theta / 2)]],
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(gates.rz(theta)),
+        np.diag([np.exp(-0.5j * theta), np.exp(0.5j * theta)]),
+        atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("name", ["X", "Y", "Z", "H", "S", "T"])
+def test_fixed_gates_unitary(name):
+    g = np.asarray(getattr(gates, name))
+    np.testing.assert_allclose(g @ g.conj().T, np.eye(2), atol=1e-6)
+
+
+def test_apply_gate_matches_dense():
+    n = 4
+    psi = rand_state(n, seed=1)
+    state = jnp.asarray(psi).reshape((2,) * n)
+    for q in range(n):
+        got = apply_gate(state, gates.H, q).reshape(-1)
+        want = dense_1q(np.asarray(gates.H), q, n) @ psi
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_apply_gate_2q_matches_dense_cnot():
+    # CNOT on (control=0, target=1) for 3 qubits, big-endian axis order.
+    n = 3
+    psi = rand_state(n, seed=2)
+    state = jnp.asarray(psi).reshape((2,) * n)
+    got = apply_gate_2q(state, gates.CNOT, 0, 1).reshape(-1)
+    cnot01 = np.zeros((8, 8))
+    for i in range(8):
+        b = [(i >> 2) & 1, (i >> 1) & 1, i & 1]
+        if b[0] == 1:
+            b[1] ^= 1
+        j = (b[0] << 2) | (b[1] << 1) | b[2]
+        cnot01[j, i] = 1.0
+    np.testing.assert_allclose(np.asarray(got), cnot01 @ psi, atol=1e-5)
+
+
+def test_apply_gate_2q_nonadjacent_and_reversed():
+    n = 3
+    psi = rand_state(n, seed=3)
+    state = jnp.asarray(psi).reshape((2,) * n)
+    # control=2, target=0
+    got = apply_gate_2q(state, gates.CNOT, 2, 0).reshape(-1)
+    mat = np.zeros((8, 8))
+    for i in range(8):
+        b = [(i >> 2) & 1, (i >> 1) & 1, i & 1]
+        if b[2] == 1:
+            b[0] ^= 1
+        j = (b[0] << 2) | (b[1] << 1) | b[2]
+        mat[j, i] = 1.0
+    np.testing.assert_allclose(np.asarray(got), mat @ psi, atol=1e-5)
+
+
+def test_zero_state_and_probabilities():
+    s = zero_state(3)
+    p = probabilities(s)
+    assert p.shape == (8,)
+    np.testing.assert_allclose(np.asarray(p), [1, 0, 0, 0, 0, 0, 0, 0], atol=1e-7)
+
+
+def test_product_state_matches_sequential_gates():
+    angles = jnp.array([0.3, 1.1, 2.0])
+    amps = jnp.stack([jnp.cos(angles / 2), jnp.sin(angles / 2)], axis=-1)
+    direct = product_state(amps.astype(jnp.complex64))
+    seq = zero_state(3)
+    for q in range(3):
+        seq = apply_gate(seq, gates.ry(angles[q]), q)
+    np.testing.assert_allclose(np.asarray(direct), np.asarray(seq), atol=1e-6)
+
+
+def test_expect_z_values():
+    s = zero_state(2)
+    assert np.asarray(expect_z(s, 0)) == pytest.approx(1.0)
+    s = apply_gate(s, gates.X, 1)
+    assert np.asarray(expect_z(s, 1)) == pytest.approx(-1.0)
+    s = apply_gate(s, gates.H, 0)
+    assert np.asarray(expect_z(s, 0)) == pytest.approx(0.0, abs=1e-6)
+    np.testing.assert_allclose(np.asarray(expect_z_all(s)), [0.0, -1.0], atol=1e-6)
+
+
+def test_state_norm_preserved_through_circuit():
+    state = zero_state(4)
+    key = jax.random.PRNGKey(0)
+    for q in range(4):
+        state = apply_gate(state, gates.ry(jax.random.uniform(jax.random.fold_in(key, q))), q)
+    for q in range(3):
+        state = apply_gate_2q(state, gates.CNOT, q, q + 1)
+    assert float(jnp.sum(probabilities(state))) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_fidelity_self_and_orthogonal():
+    a = zero_state(2)
+    b = apply_gate(zero_state(2), gates.X, 0)
+    assert float(fidelity(a, a)) == pytest.approx(1.0, abs=1e-6)
+    assert float(fidelity(a, b)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_engine_jits_and_vmaps():
+    def circuit(theta):
+        s = zero_state(3)
+        for q in range(3):
+            s = apply_gate(s, gates.ry(theta[q]), q)
+        s = apply_gate_2q(s, gates.CNOT, 0, 1)
+        return expect_z(s, 1)
+
+    thetas = jnp.array([[0.1, 0.2, 0.3], [1.0, 1.1, 1.2]])
+    out = jax.jit(jax.vmap(circuit))(thetas)
+    assert out.shape == (2,)
+    single = circuit(thetas[0])
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(single), atol=1e-6)
