@@ -1,0 +1,3 @@
+from qfedx_tpu.fed.config import DPConfig, FedConfig  # noqa: F401
+from qfedx_tpu.fed.round import make_fed_round  # noqa: F401
+from qfedx_tpu.fed.evaluate import make_evaluator  # noqa: F401

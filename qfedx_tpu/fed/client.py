@@ -1,0 +1,103 @@
+"""Local client training — the per-chip inner loop.
+
+Capability parity with the reference's ``client_update`` (reference
+src/CFed/Classical_FL.py:40-64: fresh model from global weights, SGD
+lr/momentum, CrossEntropyLoss, E epochs over a shuffled DataLoader, returns
+(new weights, sample count)), redesigned for XLA:
+
+- The whole local run is one traced program: ``lax.scan`` over epochs, and
+  inside each epoch a ``lax.scan`` over batches of a freshly shuffled
+  permutation (``jax.random.permutation`` per epoch replaces DataLoader
+  shuffling). No Python control flow at run time.
+- Client datasets are padded to a static [S, ...] with a validity mask
+  (see data.partition.pack_clients); padded samples carry zero loss weight,
+  so results are exact, not approximate, under padding.
+- Returns the *update* Δθ = θ_local − θ_global (the roadmap's client
+  contract, ROADMAP.md:36-37) with model-specific wrapping (angle deltas →
+  [−π, π]), plus the effective sample count and mean loss.
+- FedProx: adds (μ/2)·‖θ − θ_global‖² to the local loss (BASELINE.md
+  config 3; FedProx = reference extension per SURVEY §2.3).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from qfedx_tpu.fed.config import FedConfig
+from qfedx_tpu.models.api import Model
+from qfedx_tpu.utils import trees
+
+
+def make_optimizer(cfg: FedConfig) -> optax.GradientTransformation:
+    if cfg.optimizer == "adam":
+        return optax.adam(cfg.learning_rate)
+    return optax.sgd(cfg.learning_rate, momentum=cfg.momentum or None)
+
+
+def make_local_update(model: Model, cfg: FedConfig) -> Callable:
+    """Build ``local_update(global_params, x, y, mask, key)``.
+
+    Shapes: x [S, ...], y [S], mask [S]; S must be a multiple of
+    cfg.batch_size (use pack_clients(pad_multiple=batch_size)).
+    Returns (delta, n_samples, mean_loss).
+    """
+    tx = make_optimizer(cfg)
+
+    def loss_fn(params, global_params, xb, yb, mb, key):
+        if model.apply_train is not None:
+            logits = model.apply_train(params, xb, key)
+        else:
+            logits = model.apply(params, xb)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+        loss = jnp.sum(ce * mb) / jnp.maximum(jnp.sum(mb), 1.0)
+        if cfg.algorithm == "fedprox":
+            prox = trees.global_norm_sq(trees.tree_sub(params, global_params))
+            loss = loss + 0.5 * cfg.prox_mu * prox
+        return loss
+
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def local_update(global_params, x, y, mask, key):
+        x, y, mask = jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask)
+        s = x.shape[0]
+        if s % cfg.batch_size != 0:
+            raise ValueError(
+                f"padded client size {s} not a multiple of batch {cfg.batch_size}"
+            )
+        n_batches = s // cfg.batch_size
+        opt_state = tx.init(global_params)
+
+        def epoch_body(carry, epoch_key):
+            params, opt_state = carry
+            k_perm, k_drop = jax.random.split(epoch_key)
+            perm = jax.random.permutation(k_perm, s)
+            xs = x[perm].reshape((n_batches, cfg.batch_size) + x.shape[1:])
+            ys = y[perm].reshape(n_batches, cfg.batch_size)
+            ms = mask[perm].reshape(n_batches, cfg.batch_size)
+            bkeys = jax.random.split(k_drop, n_batches)
+
+            def batch_body(carry, batch):
+                params, opt_state = carry
+                xb, yb, mb, bk = batch
+                loss, grads = grad_fn(params, global_params, xb, yb, mb, bk)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                batch_body, (params, opt_state), (xs, ys, ms, bkeys)
+            )
+            return (params, opt_state), jnp.mean(losses)
+
+        epoch_keys = jax.random.split(key, cfg.local_epochs)
+        (params, _), epoch_losses = jax.lax.scan(
+            epoch_body, (global_params, opt_state), epoch_keys
+        )
+        delta = model.wrap_delta(trees.tree_sub(params, global_params))
+        return delta, jnp.sum(mask), jnp.mean(epoch_losses)
+
+    return local_update

@@ -1,0 +1,48 @@
+"""Federated training configuration.
+
+One typed schema replacing the reference's hard-coded config dicts
+triplicated across entry points (reference src/CFed/Classical_FL.py:161-173,
+src/QFed/testEncoder.py:64-72, src/CFed/Preprocess.py:239-247; SURVEY.md §5
+Config row). Defaults mirror the reference's classical FL loop: 5 local
+epochs, batch 32, SGD lr 0.01 momentum 0.9 (Classical_FL.py:40-42,53).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class DPConfig:
+    """Differential privacy: clip Δθ to ℓ2 norm C, add N(0, σ²C²I)
+    (reference ROADMAP.md:50-51,140-141)."""
+
+    clip_norm: float = 1.0
+    noise_multiplier: float = 1.0
+    delta: float = 1e-5  # reporting δ (ROADMAP.md:113)
+
+
+@dataclass(frozen=True)
+class FedConfig:
+    local_epochs: int = 5
+    batch_size: int = 32
+    learning_rate: float = 0.01
+    momentum: float = 0.9
+    optimizer: str = "sgd"  # "sgd" | "adam" (ROADMAP.md:38 wants Adam too)
+    algorithm: str = "fedavg"  # "fedavg" | "fedprox"
+    prox_mu: float = 0.0  # FedProx proximal strength (BASELINE.md config 3)
+    client_fraction: float = 1.0  # client sampling p (ROADMAP.md:106)
+    dp: DPConfig | None = None
+    secure_agg: bool = False
+    secure_agg_scale: float = 1.0  # std of pairwise masks (ROADMAP.md:52-55)
+    # Under DP, clients are weighted uniformly (sample-count weights would
+    # leak dataset sizes through the sensitivity analysis).
+    dp_uniform_weights: bool = True
+
+    def __post_init__(self):
+        if self.algorithm not in ("fedavg", "fedprox"):
+            raise ValueError(f"unknown algorithm {self.algorithm!r}")
+        if self.optimizer not in ("sgd", "adam"):
+            raise ValueError(f"unknown optimizer {self.optimizer!r}")
+        if self.algorithm == "fedprox" and self.prox_mu <= 0:
+            raise ValueError("fedprox requires prox_mu > 0")

@@ -1,0 +1,67 @@
+"""Model evaluation: accuracy and AUC.
+
+Capability parity with the reference evaluator (reference
+src/CFed/Classical_FL.py:83-102: batch-256, no-grad accuracy) plus the AUC
+metric the roadmap asks for (ROADMAP.md:112). The batched forward is one
+jitted program over padded batches (static shapes), gradients never built.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from qfedx_tpu.models.api import Model
+
+
+def make_evaluator(model: Model, batch_size: int = 256):
+    """Return ``evaluate(params, x, y) -> dict`` computing accuracy and
+    (for binary problems) one-vs-rest AUC on host from device logits."""
+
+    @jax.jit
+    def batch_logits(params, xb):
+        return model.apply(params, xb)
+
+    def evaluate(params, x, y):
+        x = np.asarray(x, dtype=np.float32)
+        y = np.asarray(y)
+        n = len(x)
+        pad = (-n) % batch_size
+        if pad:
+            x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+        logits = []
+        for i in range(0, len(x), batch_size):
+            logits.append(np.asarray(batch_logits(params, jnp.asarray(x[i : i + batch_size]))))
+        logits = np.concatenate(logits)[:n]
+        pred = logits.argmax(axis=-1)
+        acc = float((pred == y).mean()) if n else 0.0
+        out = {"accuracy": acc, "n": n}
+        if logits.shape[-1] == 2:
+            out["auc"] = binary_auc(y, logits[:, 1] - logits[:, 0])
+        return out
+
+    return evaluate
+
+
+def binary_auc(labels: np.ndarray, scores: np.ndarray) -> float:
+    """ROC AUC via the rank-sum (Mann–Whitney U) formulation, with tie
+    handling by average ranks. Pure numpy — no sklearn dependency."""
+    labels = np.asarray(labels).astype(bool)
+    n_pos = int(labels.sum())
+    n_neg = len(labels) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty(len(scores), dtype=np.float64)
+    sorted_scores = scores[order]
+    i = 0
+    while i < len(scores):
+        j = i
+        while j + 1 < len(scores) and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        ranks[order[i : j + 1]] = 0.5 * (i + j) + 1.0
+        i = j + 1
+    rank_sum_pos = ranks[labels].sum()
+    u = rank_sum_pos - n_pos * (n_pos + 1) / 2.0
+    return float(u / (n_pos * n_neg))

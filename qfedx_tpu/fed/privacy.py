@@ -1,0 +1,32 @@
+"""On-device differential privacy primitives.
+
+Reference spec (ROADMAP.md:50-51,140-141): clip each client's update Δθ to
+ℓ2 norm C, then add Gaussian noise N(0, σ²C²I). Both run on-device from
+per-client ``jax.random`` streams (BASELINE.json north star: "DP-SGD noise
+… move[s] to jax.random on-device"), inside the same SPMD round program as
+training and aggregation — no host round-trip.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from qfedx_tpu.fed.config import DPConfig
+from qfedx_tpu.utils import trees
+
+
+def clip_by_global_norm(delta, clip_norm: float):
+    """Scale the whole pytree so its global ℓ2 norm is ≤ clip_norm."""
+    norm = trees.global_norm(delta)
+    factor = jnp.minimum(1.0, clip_norm / jnp.maximum(norm, 1e-12))
+    return trees.tree_scale(delta, factor)
+
+
+def privatize(delta, dp: DPConfig, key: jax.Array):
+    """Clip + noise: Δ̃ = clip_C(Δ) + N(0, σ²C²I)."""
+    clipped = clip_by_global_norm(delta, dp.clip_norm)
+    noise = trees.tree_random_normal(key, delta)
+    return trees.tree_add(
+        clipped, trees.tree_scale(noise, dp.noise_multiplier * dp.clip_norm)
+    )

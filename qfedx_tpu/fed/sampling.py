@@ -1,0 +1,27 @@
+"""Client sampling with static program shape.
+
+Reference spec: client fraction p ∈ {0.1, 0.3, 1.0} (ROADMAP.md:106) with
+server-side sampling (ROADMAP.md:35). Under SPMD every client trains every
+round (the program shape is static — SURVEY.md §7.3.2); sampling is a 0/1
+participation mask applied to aggregation weights, derived deterministically
+from the replicated round key so every device agrees on the cohort without
+communication. Unsampled clients do dead work (masked out), which is the
+standard static-shape trade: at full participation (the reference default)
+there is no waste at all.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def participation_mask(
+    round_key: jax.Array, num_clients: int, fraction: float
+) -> jnp.ndarray:
+    """[num_clients] float 0/1 cohort mask; all-ones when fraction ≥ 1."""
+    if fraction >= 1.0:
+        return jnp.ones((num_clients,), dtype=jnp.float32)
+    return jax.random.bernoulli(
+        jax.random.fold_in(round_key, 0x5A3D), fraction, (num_clients,)
+    ).astype(jnp.float32)

@@ -1,0 +1,65 @@
+"""Secure aggregation via pairwise antisymmetric PRG masks, on-device.
+
+Reference spec (ROADMAP.md:52-55,137-138): for each client pair i<j generate
+a mask m_ij; client i adds +m_ij, client j adds −m_ij, so the server-side
+sum of masked updates equals the sum of raw updates while no individual
+update is ever visible in the clear.
+
+TPU-native construction (BASELINE.json north star: "secure-aggregation
+masks move to jax.random on-device"): the pair key is a deterministic fold
+of a shared round key with (min(i,j), max(i,j)) — the SPMD analog of the
+roadmap's simulated DH seed exchange at registration; every device can
+derive its pair keys locally with zero communication. Masks are sampled
+leaf-by-leaf with ``trees.tree_random_normal``, accumulated over peers with
+``lax.scan`` so memory stays O(|θ|) regardless of cohort size.
+
+Client-sampling interaction: a pair's masks must cancel, so pair (i, j)
+is masked only when *both* are in the round's cohort. Cohort membership is
+derived from the replicated round key (``fed.sampling``), so every client
+computes every peer's membership locally — the jit-friendly stand-in for
+the real protocol's mask-recovery phase (SURVEY.md §7.3.3).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from qfedx_tpu.utils import trees
+
+
+def pair_key(base_key: jax.Array, i, j) -> jax.Array:
+    """Symmetric per-pair key: fold (min, max) so both ends agree."""
+    lo = jnp.minimum(i, j)
+    hi = jnp.maximum(i, j)
+    return jax.random.fold_in(jax.random.fold_in(base_key, lo), hi)
+
+
+def client_mask(
+    base_key: jax.Array,
+    client_id,
+    num_clients: int,
+    template,
+    participation,  # [num_clients] 0/1 — cohort membership this round
+    scale: float = 1.0,
+):
+    """Σ_j sign(j − i) · 1[both participate] · PRG(pair_key(i,j)) as a pytree
+    shaped like ``template``. Antisymmetric in (i, j) by construction, so
+    masks cancel under the cohort-wide sum."""
+    zeros = trees.tree_zeros_like(template)
+    my_part = participation[client_id]
+
+    def body(acc, j):
+        coeff = (
+            jnp.where(j > client_id, 1.0, -1.0)
+            * jnp.where(j == client_id, 0.0, 1.0)
+            * participation[j]
+            * my_part
+            * scale
+        )
+        m = trees.tree_random_normal(pair_key(base_key, client_id, j), template)
+        acc = jax.tree.map(lambda a, x: a + coeff * x, acc, m)
+        return acc, None
+
+    masked, _ = jax.lax.scan(body, zeros, jnp.arange(num_clients))
+    return masked

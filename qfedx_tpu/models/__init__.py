@@ -1,0 +1,3 @@
+from qfedx_tpu.models.api import Model  # noqa: F401
+from qfedx_tpu.models.vqc import make_vqc_classifier  # noqa: F401
+from qfedx_tpu.models.cnn import make_tiny_cnn  # noqa: F401

@@ -1,0 +1,43 @@
+"""The model contract shared by quantum and classical models.
+
+The reference couples its training loop to `torch.nn.Module` state_dicts
+(reference src/CFed/Classical_FL.py:40-64). Here a model is three pure
+functions over pytrees, so the federated runtime is model-agnostic and the
+classical CNN baseline "rides the same harness" as the VQC — the
+apples-to-apples requirement (reference ROADMAP.md:109; BASELINE.json north
+star).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+
+Params = Any
+
+
+def _identity(delta: Params) -> Params:
+    return delta
+
+
+@dataclass(frozen=True)
+class Model:
+    """A model is:
+
+    - ``init(key) -> params`` — build a parameter pytree.
+    - ``apply(params, x) -> logits`` — batched forward: x [B, ...] → [B, K].
+    - ``wrap_delta(delta) -> delta`` — post-process a parameter *update*
+      before aggregation; VQC models wrap rotation-angle deltas to [−π, π]
+      to respect gate periodicity (reference ROADMAP.md:37), classical
+      models pass through.
+    """
+
+    init: Callable[[jax.Array], Params]
+    apply: Callable[[Params, jax.Array], jax.Array]
+    wrap_delta: Callable[[Params], Params] = field(default=_identity)
+    name: str = "model"
+    # Optional stochastic forward for local training (e.g. dropout):
+    # (params, x, key) -> logits. Falls back to ``apply`` when None.
+    apply_train: Callable[[Params, jax.Array, jax.Array], jax.Array] | None = None

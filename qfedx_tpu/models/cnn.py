@@ -1,0 +1,78 @@
+"""Classical CNN baseline on the same federated harness.
+
+Capability parity with the reference's TinyCNN (reference
+src/CFed/Classical_FL.py:21-38): conv(→16, 5×5, same) → ReLU → maxpool2 →
+conv(→32, 5×5, same) → ReLU → maxpool2 → dense(64) → dropout(0.5) →
+dense(num_classes). Implemented in flax.linen with NHWC layout (TPU conv
+layout; torch uses NCHW) and exposed through the same ``Model`` contract as
+the VQC, so the classical baseline rides the identical SPMD federated round
+(reference ROADMAP.md:109's apples-to-apples requirement).
+
+Dropout note: the reference trains dropout in its client loop; federated
+local training here is deterministic per (client, round) via fold-in PRNG
+streams. For simplicity and jit-friendliness, dropout is applied only when
+a PRNG key is provided to ``apply_train``; the Model.apply used for
+evaluation is deterministic (torch ``model.eval()`` semantics).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from qfedx_tpu.models.api import Model
+
+
+class TinyCNN(nn.Module):
+    num_classes: int = 3
+    channels: tuple[int, int] = (16, 32)
+    hidden: int = 64
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        # x: [B, H, W, C] float32 in [0, 1]
+        for ch in self.channels:
+            x = nn.Conv(ch, kernel_size=(5, 5), padding="SAME")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def make_tiny_cnn(
+    num_classes: int = 3,
+    height: int = 28,
+    width: int = 28,
+    in_channels: int = 1,
+) -> Model:
+    """TinyCNN as a framework Model. Accepts [B,H,W] or [B,H,W,C] inputs."""
+    module = TinyCNN(num_classes=num_classes)
+    sample = jnp.zeros((1, height, width, in_channels), dtype=jnp.float32)
+
+    def _with_channel(x):
+        return x[..., None] if x.ndim == 3 else x
+
+    def init(key: jax.Array):
+        return module.init(key, sample)["params"]
+
+    def apply(params, x):
+        return module.apply({"params": params}, _with_channel(x))
+
+    def apply_train(params, x, dropout_key):
+        return module.apply(
+            {"params": params},
+            _with_channel(x),
+            train=True,
+            rngs={"dropout": dropout_key},
+        )
+
+    return Model(
+        init=init,
+        apply=apply,
+        apply_train=apply_train,
+        name=f"tinycnn{num_classes}c",
+    )

@@ -1,0 +1,101 @@
+"""Variational quantum circuit classifier.
+
+The model the reference specifies but never builds (reference
+ROADMAP.md:20-23,126-128; SURVEY.md §2.3): encoder → hardware-efficient
+ansatz → ⟨Z⟩ readout → logits. Three encoder families cover the BASELINE.md
+config grid:
+
+- ``angle``     — one RY(π·f) per qubit (configs 1–2).
+- ``amplitude`` — features as state amplitudes, 2^n features on n qubits.
+- ``reupload``  — data-reuploading: trainable re-encoding between layers
+                  (config 4).
+
+The forward pass simulates the circuit with the dense engine in
+``ops.statevector`` and is differentiated with ``jax.grad`` end-to-end.
+Rotation-angle parameters are periodic, so ``wrap_delta`` wraps their
+updates to [−π, π] before aggregation (reference ROADMAP.md:37).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from qfedx_tpu.circuits.ansatz import (
+    data_reuploading,
+    hardware_efficient,
+    init_ansatz_params,
+    init_reuploading_params,
+)
+from qfedx_tpu.circuits.encoders import amplitude_encode, angle_encode
+from qfedx_tpu.circuits.readout import init_readout_params, z_logits
+from qfedx_tpu.models.api import Model
+
+# Parameter leaves that are rotation angles (periodic in 2π). Readout
+# scale/bias are ordinary affine parameters and must NOT be wrapped.
+_ANGLE_LEAVES = frozenset({"rx", "rz", "enc_b"})
+
+
+def wrap_angle(x: jnp.ndarray) -> jnp.ndarray:
+    """Wrap to [−π, π): (x + π) mod 2π − π."""
+    return jnp.mod(x + jnp.pi, 2 * jnp.pi) - jnp.pi
+
+
+def make_vqc_classifier(
+    n_qubits: int,
+    n_layers: int = 2,
+    num_classes: int = 2,
+    encoding: str = "angle",
+    basis: str = "ry",
+    init_scale: float = 0.1,
+    noise_model=None,
+) -> Model:
+    """Build the VQC classifier Model.
+
+    Input features: shape (B, n_qubits) in [0,1] for angle/reupload
+    encodings, (B, 2^n_qubits) for amplitude. ``noise_model``: optional
+    ``noise.channels.NoiseModel`` applied between circuit and readout.
+    """
+    if num_classes > n_qubits:
+        raise ValueError(f"need n_qubits ≥ num_classes ({num_classes})")
+    if encoding not in ("angle", "amplitude", "reupload"):
+        raise ValueError(f"unknown encoding {encoding!r}")
+
+    def init(key: jax.Array):
+        k_ansatz, k_read = jax.random.split(key)
+        if encoding == "reupload":
+            ansatz = init_reuploading_params(k_ansatz, n_qubits, n_layers, init_scale)
+        else:
+            ansatz = init_ansatz_params(k_ansatz, n_qubits, n_layers, init_scale)
+        return {"ansatz": ansatz, "readout": init_readout_params(k_read, num_classes)}
+
+    def forward_state(params, x):
+        if encoding == "reupload":
+            return data_reuploading(x, params["ansatz"])
+        enc = angle_encode(x, basis) if encoding == "angle" else amplitude_encode(x)
+        return hardware_efficient(enc, params["ansatz"])
+
+    def apply_one(params, x, key=None):
+        state = forward_state(params, x)
+        if noise_model is not None:
+            return noise_model.noisy_logits(state, params["readout"], key)
+        return z_logits(state, params["readout"])
+
+    def apply(params, x):
+        return jax.vmap(lambda xi: apply_one(params, xi))(x)
+
+    def wrap_delta(delta):
+        return {
+            "ansatz": {
+                k: (wrap_angle(v) if k in _ANGLE_LEAVES else v)
+                for k, v in delta["ansatz"].items()
+            },
+            "readout": delta["readout"],
+        }
+
+    return Model(
+        init=init,
+        apply=apply,
+        wrap_delta=wrap_delta,
+        name=f"vqc{n_qubits}q{n_layers}l-{encoding}",
+    )

@@ -38,11 +38,17 @@ def tree_map_with_path(fn: Callable, tree: Pytree) -> Pytree:
     return jax.tree_util.tree_map_with_path(fn, tree)
 
 
+def global_norm_sq(tree: Pytree) -> jax.Array:
+    """Squared ℓ2 norm across the whole pytree. Use this (not
+    ``global_norm(t)**2``) inside differentiated code: sqrt at 0 has an
+    infinite gradient, which NaNs e.g. the FedProx term on the first step."""
+    return sum(jnp.sum(jnp.square(x)) for x in jax.tree.leaves(tree))
+
+
 def global_norm(tree: Pytree) -> jax.Array:
     """ℓ2 norm across the whole pytree (DP clipping operates on this,
     per reference ROADMAP.md:50-51: "Clip Δθ to ℓ2 norm C")."""
-    leaves = jax.tree.leaves(tree)
-    return jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in leaves))
+    return jnp.sqrt(global_norm_sq(tree))
 
 
 def tree_size(tree: Pytree) -> int:

@@ -1,0 +1,246 @@
+"""Federated runtime tests on the 8-device virtual CPU mesh.
+
+The central equivalence check: the one-program SPMD round must reproduce the
+reference's sequential semantics (per-client local training then
+sample-weighted averaging — reference src/CFed/Classical_FL.py:104-157)
+exactly, because it is the same math reorganized, not an approximation.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from qfedx_tpu.fed.client import make_local_update
+from qfedx_tpu.fed.config import DPConfig, FedConfig
+from qfedx_tpu.fed.round import client_mesh, make_fed_round, shard_client_data
+from qfedx_tpu.models.api import Model
+from qfedx_tpu.utils import trees
+
+
+def linear_model(dim=4, classes=2):
+    """Tiny deterministic linear model — fast, convex, exact-math friendly."""
+
+    def init(key):
+        return {
+            "w": jnp.zeros((dim, classes), dtype=jnp.float32),
+            "b": jnp.zeros((classes,), dtype=jnp.float32),
+        }
+
+    def apply(params, x):
+        return x @ params["w"] + params["b"]
+
+    return Model(init=init, apply=apply, name="linear")
+
+
+def make_client_data(num_clients=8, samples=16, dim=4, seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(dim,))
+    cx = rng.normal(size=(num_clients, samples, dim)).astype(np.float32)
+    cy = (cx @ w_true > 0).astype(np.int32)
+    cmask = np.ones((num_clients, samples), dtype=np.float32)
+    return jnp.asarray(cx), jnp.asarray(cy), cmask, w_true
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return client_mesh()
+
+
+def _sequential_round(model, cfg, params, cx, cy, cmask, round_key, num_clients):
+    """Host-side re-implementation of one round with the same PRNG layout
+    as fed.round.make_fed_round — the reference-semantics oracle."""
+    local_update = make_local_update(model, cfg)
+    train_key = jax.random.fold_in(round_key, 0x7A41)
+    deltas, weights = [], []
+    for cid in range(num_clients):
+        delta, n, _ = local_update(
+            params, cx[cid], cy[cid], cmask[cid], jax.random.fold_in(train_key, cid)
+        )
+        deltas.append(delta)
+        weights.append(float(n))
+    total = sum(weights)
+    agg = trees.tree_zeros_like(params)
+    for d, w in zip(deltas, weights):
+        agg = trees.tree_add(agg, trees.tree_scale(d, w / total))
+    return trees.tree_add(params, agg)
+
+
+def test_spmd_round_matches_sequential_semantics(mesh):
+    model = linear_model()
+    cfg = FedConfig(local_epochs=2, batch_size=8, learning_rate=0.1, momentum=0.0)
+    cx, cy, cmask, _ = make_client_data()
+    params = model.init(jax.random.PRNGKey(0))
+    round_key = jax.random.PRNGKey(42)
+
+    round_fn = make_fed_round(model, cfg, mesh, num_clients=8)
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cmask))
+    new_params, stats = round_fn(params, scx, scy, scm, round_key)
+
+    expected = _sequential_round(model, cfg, params, cx, cy, cmask, round_key, 8)
+    for k in expected:
+        np.testing.assert_allclose(
+            np.asarray(new_params[k]), np.asarray(expected[k]), atol=1e-5
+        )
+    assert float(stats.total_weight) == pytest.approx(8 * 16)
+    assert float(stats.num_participants) == 8
+
+
+def test_round_with_client_blocks(mesh):
+    """16 clients on 8 devices → blocks of 2 per device (SURVEY §7.3.5)."""
+    model = linear_model()
+    cfg = FedConfig(local_epochs=1, batch_size=8, learning_rate=0.1, momentum=0.0)
+    cx, cy, cmask, _ = make_client_data(num_clients=16)
+    params = model.init(jax.random.PRNGKey(0))
+    round_key = jax.random.PRNGKey(7)
+
+    round_fn = make_fed_round(model, cfg, mesh, num_clients=16)
+    new_params, stats = round_fn(params, cx, cy, jnp.asarray(cmask), round_key)
+    expected = _sequential_round(model, cfg, params, cx, cy, cmask, round_key, 16)
+    for k in expected:
+        np.testing.assert_allclose(
+            np.asarray(new_params[k]), np.asarray(expected[k]), atol=1e-5
+        )
+
+
+def test_empty_client_contributes_zero(mesh):
+    model = linear_model()
+    cfg = FedConfig(local_epochs=1, batch_size=8, learning_rate=0.1, momentum=0.0)
+    cx, cy, cmask, _ = make_client_data()
+    cmask = cmask.copy()
+    cmask[3] = 0.0  # client 3 has no data (Dirichlet small-α case, SURVEY §7.4)
+    params = model.init(jax.random.PRNGKey(0))
+    round_fn = make_fed_round(model, cfg, mesh, num_clients=8)
+    new_params, stats = round_fn(params, cx, cy, jnp.asarray(cmask), jax.random.PRNGKey(1))
+    assert float(stats.total_weight) == pytest.approx(7 * 16)
+    assert np.all(np.isfinite(np.asarray(new_params["w"])))
+
+
+def test_client_sampling_reduces_participants(mesh):
+    model = linear_model()
+    cfg = FedConfig(
+        local_epochs=1, batch_size=8, learning_rate=0.1, momentum=0.0, client_fraction=0.5
+    )
+    cx, cy, cmask, _ = make_client_data()
+    params = model.init(jax.random.PRNGKey(0))
+    round_fn = make_fed_round(model, cfg, mesh, num_clients=8)
+    _, stats = round_fn(params, cx, cy, jnp.asarray(cmask), jax.random.PRNGKey(3))
+    n_part = float(stats.num_participants)
+    assert 0 <= n_part < 8  # strictly fewer than all with high probability
+
+
+def test_zero_participants_is_noop(mesh):
+    model = linear_model()
+    cfg = FedConfig(
+        local_epochs=1, batch_size=8, learning_rate=0.5, momentum=0.0, client_fraction=1e-6
+    )
+    cx, cy, cmask, _ = make_client_data()
+    params = {"w": jnp.ones((4, 2)), "b": jnp.zeros((2,))}
+    round_fn = make_fed_round(model, cfg, mesh, num_clients=8)
+    new_params, stats = round_fn(params, cx, cy, jnp.asarray(cmask), jax.random.PRNGKey(0))
+    assert float(stats.num_participants) == 0
+    np.testing.assert_allclose(np.asarray(new_params["w"]), 1.0, atol=1e-6)
+
+
+def test_secure_agg_masks_cancel(mesh):
+    """ROADMAP.md:55,61 unit test: masked aggregation ≡ raw aggregation."""
+    model = linear_model()
+    base = dict(local_epochs=1, batch_size=8, learning_rate=0.1, momentum=0.0)
+    cx, cy, cmask, _ = make_client_data()
+    params = model.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(11)
+
+    plain = make_fed_round(model, FedConfig(**base), mesh, num_clients=8)
+    masked = make_fed_round(
+        model, FedConfig(**base, secure_agg=True, secure_agg_scale=5.0), mesh, num_clients=8
+    )
+    p_plain, _ = plain(params, cx, cy, jnp.asarray(cmask), key)
+    p_masked, _ = masked(params, cx, cy, jnp.asarray(cmask), key)
+    for k in p_plain:
+        np.testing.assert_allclose(
+            np.asarray(p_plain[k]), np.asarray(p_masked[k]), atol=1e-4
+        )
+
+
+def test_secure_agg_cancels_under_sampling(mesh):
+    model = linear_model()
+    base = dict(
+        local_epochs=1, batch_size=8, learning_rate=0.1, momentum=0.0, client_fraction=0.6
+    )
+    cx, cy, cmask, _ = make_client_data()
+    params = model.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(13)
+    plain = make_fed_round(model, FedConfig(**base), mesh, num_clients=8)
+    masked = make_fed_round(
+        model, FedConfig(**base, secure_agg=True, secure_agg_scale=3.0), mesh, num_clients=8
+    )
+    p_plain, s_plain = plain(params, cx, cy, jnp.asarray(cmask), key)
+    p_masked, s_masked = masked(params, cx, cy, jnp.asarray(cmask), key)
+    assert float(s_plain.num_participants) == float(s_masked.num_participants)
+    for k in p_plain:
+        np.testing.assert_allclose(
+            np.asarray(p_plain[k]), np.asarray(p_masked[k]), atol=1e-4
+        )
+
+
+def test_dp_clip_bounds_update_and_noise_present(mesh):
+    model = linear_model()
+    cx, cy, cmask, _ = make_client_data()
+    params = model.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(5)
+
+    # σ=0: pure clipping. The aggregated update is a convex combination of
+    # per-client clipped deltas, so its norm is ≤ C.
+    clip_cfg = FedConfig(
+        local_epochs=3,
+        batch_size=8,
+        learning_rate=1.0,
+        momentum=0.0,
+        dp=DPConfig(clip_norm=0.05, noise_multiplier=0.0),
+    )
+    round_fn = make_fed_round(model, clip_cfg, mesh, num_clients=8)
+    new_params, _ = round_fn(params, cx, cy, jnp.asarray(cmask), key)
+    update_norm = float(trees.global_norm(trees.tree_sub(new_params, params)))
+    assert update_norm <= 0.05 + 1e-5
+
+    # σ>0: same round differs from σ=0 (noise actually lands).
+    noisy_cfg = FedConfig(
+        local_epochs=3,
+        batch_size=8,
+        learning_rate=1.0,
+        momentum=0.0,
+        dp=DPConfig(clip_norm=0.05, noise_multiplier=1.0),
+    )
+    noisy_fn = make_fed_round(model, noisy_cfg, mesh, num_clients=8)
+    noisy_params, _ = noisy_fn(params, cx, cy, jnp.asarray(cmask), key)
+    assert not np.allclose(
+        np.asarray(noisy_params["w"]), np.asarray(new_params["w"]), atol=1e-6
+    )
+
+
+def test_fedprox_stays_closer_to_global(mesh):
+    model = linear_model()
+    cx, cy, cmask, _ = make_client_data()
+    params = model.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(9)
+    base = dict(local_epochs=5, batch_size=8, learning_rate=0.1, momentum=0.0)
+    avg = make_fed_round(model, FedConfig(**base), mesh, num_clients=8)
+    prox = make_fed_round(
+        model, FedConfig(**base, algorithm="fedprox", prox_mu=1.0), mesh, num_clients=8
+    )
+    p_avg, _ = avg(params, cx, cy, jnp.asarray(cmask), key)
+    p_prox, _ = prox(params, cx, cy, jnp.asarray(cmask), key)
+    d_avg = float(trees.global_norm(trees.tree_sub(p_avg, params)))
+    d_prox = float(trees.global_norm(trees.tree_sub(p_prox, params)))
+    assert d_prox < d_avg
+
+
+def test_adam_optimizer_round_runs(mesh):
+    model = linear_model()
+    cfg = FedConfig(local_epochs=1, batch_size=8, learning_rate=0.01, optimizer="adam")
+    cx, cy, cmask, _ = make_client_data()
+    params = model.init(jax.random.PRNGKey(0))
+    round_fn = make_fed_round(model, cfg, mesh, num_clients=8)
+    new_params, _ = round_fn(params, cx, cy, jnp.asarray(cmask), jax.random.PRNGKey(2))
+    assert np.all(np.isfinite(np.asarray(new_params["w"])))
+    assert not np.allclose(np.asarray(new_params["w"]), 0.0)

@@ -1,0 +1,93 @@
+"""DP primitives and the RDP accountant (reference ROADMAP.md Phase 3)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from qfedx_tpu.fed.accountant import RDPAccountant, rdp_subsampled_gaussian, DEFAULT_ORDERS
+from qfedx_tpu.fed.config import DPConfig
+from qfedx_tpu.fed.privacy import clip_by_global_norm, privatize
+from qfedx_tpu.utils import trees
+
+
+def test_clip_noop_below_threshold():
+    tree = {"a": jnp.array([0.3, 0.4])}  # norm 0.5
+    out = clip_by_global_norm(tree, 1.0)
+    np.testing.assert_allclose(np.asarray(out["a"]), [0.3, 0.4], atol=1e-7)
+
+
+def test_clip_scales_to_norm():
+    tree = {"a": jnp.array([3.0, 4.0])}  # norm 5
+    out = clip_by_global_norm(tree, 1.0)
+    assert float(trees.global_norm(out)) == pytest.approx(1.0, abs=1e-6)
+    # direction preserved
+    np.testing.assert_allclose(np.asarray(out["a"]), [0.6, 0.8], atol=1e-6)
+
+
+def test_privatize_noise_scale():
+    """Empirical noise std ≈ σ·C over many coordinates."""
+    dp = DPConfig(clip_norm=0.5, noise_multiplier=2.0)
+    tree = {"a": jnp.zeros(20000)}
+    out = privatize(tree, dp, jax.random.PRNGKey(0))
+    std = float(jnp.std(out["a"]))
+    assert std == pytest.approx(1.0, rel=0.05)  # σC = 2·0.5
+
+
+def test_rdp_full_batch_closed_form():
+    orders = np.array([2, 4, 8])
+    rdp = rdp_subsampled_gaussian(1.0, 2.0, orders)
+    np.testing.assert_allclose(rdp, orders / (2 * 4.0), atol=1e-12)
+
+
+def test_rdp_subsampling_amplifies():
+    orders = DEFAULT_ORDERS
+    full = rdp_subsampled_gaussian(1.0, 1.0, orders)
+    sub = rdp_subsampled_gaussian(0.1, 1.0, orders)
+    assert np.all(sub <= full + 1e-12)
+    assert sub[0] < full[0] * 0.5  # strong amplification at small q
+
+
+def test_accountant_epsilon_plausible():
+    """ROADMAP.md:62: accountant returns plausible ε for given σ, q, T, δ.
+
+    Reference regime: σ=1, q=1, T=30 rounds, δ=1e-5. Known ballpark for the
+    Gaussian mechanism under 30-fold composition: ε in the tens.
+    """
+    acct = RDPAccountant()
+    for _ in range(30):
+        acct.step(q=1.0, sigma=1.0)
+    eps = acct.epsilon(1e-5)
+    assert 5.0 < eps < 60.0
+
+    # More noise → less ε; subsampling → much less ε.
+    acct2 = RDPAccountant()
+    for _ in range(30):
+        acct2.step(q=1.0, sigma=2.0)
+    assert acct2.epsilon(1e-5) < eps
+
+    acct3 = RDPAccountant()
+    for _ in range(30):
+        acct3.step(q=0.1, sigma=1.0)
+    assert acct3.epsilon(1e-5) < acct2.epsilon(1e-5)
+
+
+def test_accountant_monotone_in_rounds():
+    acct = RDPAccountant()
+    eps_seq = []
+    for _ in range(5):
+        acct.step(q=0.3, sigma=1.5)
+        eps_seq.append(acct.epsilon(1e-5))
+    assert all(b >= a for a, b in zip(eps_seq, eps_seq[1:]))
+
+
+def test_accountant_rejects_bad_delta():
+    acct = RDPAccountant()
+    acct.step(1.0, 1.0)
+    with pytest.raises(ValueError):
+        acct.epsilon(0.0)
+
+
+def test_sigma_zero_is_infinite():
+    rdp = rdp_subsampled_gaussian(0.5, 0.0, np.array([2, 3]))
+    assert np.all(np.isinf(rdp))
