@@ -1,0 +1,1 @@
+from qfedx_tpu.run.trainer import TrainResult, train_federated  # noqa: F401

@@ -1,0 +1,82 @@
+"""End-to-end federated training: the minimum slice (SURVEY.md §7.2).
+
+BASELINE.md config 1 shape: 4-qubit angle-encoded VQC, binary
+classification, clients on a device mesh, psum FedAvg → accuracy > 0.95 on
+the synthetic learnable dataset. Plus the classical-CNN apples-to-apples
+path on the same harness.
+"""
+
+import numpy as np
+import pytest
+
+from qfedx_tpu.data.datasets import load_dataset
+from qfedx_tpu.data.partition import dirichlet_partition, iid_partition, pack_clients
+from qfedx_tpu.data.pipeline import preprocess
+from qfedx_tpu.fed.config import DPConfig, FedConfig
+from qfedx_tpu.models.cnn import make_tiny_cnn
+from qfedx_tpu.models.vqc import make_vqc_classifier
+from qfedx_tpu.run.trainer import train_federated
+
+
+def _vqc_data(num_clients=8, n_features=4, classes=(0, 1), train=1024, test=256):
+    _, tr, te = load_dataset("mnist", synthetic_train=train, synthetic_test=test, seed=1)
+    pre = preprocess(tr, te, classes=classes, features="pca", n_features=n_features)
+    parts = iid_partition(len(pre.train[0]), num_clients, seed=0)
+    cx, cy, cmask = pack_clients(*pre.train, parts, pad_multiple=32)
+    return (cx, cy, cmask), pre.test, len(classes)
+
+
+def test_vqc_fedavg_converges():
+    (cx, cy, cmask), (tx, ty), k = _vqc_data()
+    model = make_vqc_classifier(n_qubits=4, n_layers=3, num_classes=k)
+    cfg = FedConfig(local_epochs=2, batch_size=32, learning_rate=0.1, optimizer="adam")
+    res = train_federated(
+        model, cfg, cx, cy, cmask, tx, ty, num_rounds=10, eval_every=5, seed=0
+    )
+    assert res.accuracies[0] < 0.8  # untrained
+    assert res.final_accuracy > 0.95, f"accuracies: {res.accuracies}"
+
+
+def test_vqc_non_iid_dp_trains():
+    """BASELINE config-2 shape: non-IID Dirichlet clients + DP-SGD; model
+    should still learn (above chance) and ε should be tracked."""
+    _, tr, te = load_dataset("mnist", synthetic_train=1024, synthetic_test=256, seed=2)
+    pre = preprocess(tr, te, classes=(0, 1, 2), features="pca", n_features=8)
+    parts = dirichlet_partition(pre.train[1], 8, alpha=0.5, seed=0)
+    cx, cy, cmask = pack_clients(*pre.train, parts, pad_multiple=32)
+    model = make_vqc_classifier(n_qubits=8, n_layers=3, num_classes=3)
+    cfg = FedConfig(
+        local_epochs=1,
+        batch_size=32,
+        learning_rate=0.1,
+        optimizer="adam",
+        dp=DPConfig(clip_norm=1.0, noise_multiplier=0.1),
+    )
+    res = train_federated(
+        model, cfg, cx, cy, cmask, *pre.test, num_rounds=10, eval_every=10, seed=0
+    )
+    assert res.final_accuracy > 0.5, f"accuracies: {res.accuracies}"
+    assert len(res.epsilons) == 10 and res.epsilons[-1] > res.epsilons[0]
+
+
+def test_cnn_same_harness_converges():
+    """The reference's main path (TinyCNN FedAvg on 3-class data,
+    src/CFed/Classical_FL.py:159-218) on our SPMD harness."""
+    _, tr, te = load_dataset("mnist", synthetic_train=512, synthetic_test=128, seed=3)
+    pre = preprocess(tr, te, classes=(0, 1, 2), features="image")
+    parts = iid_partition(len(pre.train[0]), 4, seed=0)
+    cx, cy, cmask = pack_clients(*pre.train, parts, pad_multiple=32)
+    model = make_tiny_cnn(num_classes=3)
+    cfg = FedConfig(local_epochs=2, batch_size=32, learning_rate=0.02, momentum=0.9)
+    res = train_federated(
+        model, cfg, cx, cy, cmask, *pre.test, num_rounds=8, eval_every=4, seed=0,
+    )
+    assert res.final_accuracy > 0.9, f"accuracies: {res.accuracies}"
+
+
+def test_reupload_vqc_trains():
+    (cx, cy, cmask), (tx, ty), k = _vqc_data(train=512, test=128)
+    model = make_vqc_classifier(n_qubits=4, n_layers=2, num_classes=k, encoding="reupload")
+    cfg = FedConfig(local_epochs=2, batch_size=32, learning_rate=0.1, optimizer="adam")
+    res = train_federated(model, cfg, cx, cy, cmask, tx, ty, num_rounds=5, eval_every=5)
+    assert res.final_accuracy > 0.9, f"accuracies: {res.accuracies}"
