@@ -6,9 +6,11 @@ state constructions (no circuit objects):
 - Angle encoding (reference src/QFed/qAngle.py:27-51): one rotation per
   qubit on |0…0⟩. A bank of single-qubit rotations on |0…0⟩ *is* a product
   state, so we materialize it directly via tensor products — no gate
-  applications, O(2^n) writes total. Feature→angle normalization is fitted
-  on the training set upstream (`data.pipeline.minmax_fit`), fixing the
-  reference's per-sample min-max quirk (SURVEY.md §7.4).
+  applications, O(2^n) writes total. With the default RY basis the state is
+  purely real, which halves all downstream contraction work (ops.cpx).
+  Feature→angle normalization is fitted on the training set upstream
+  (`data.pipeline.minmax_fit`), fixing the reference's per-sample min-max
+  quirk (SURVEY.md §7.4).
 - Amplitude encoding (reference src/QFed/qAmplitude.py:11-41): ℓ2-normalize
   and reshape — on TPU there is no need for Qiskit's `initialize` circuit
   decomposition; the state is just the data. The all-zero → uniform
@@ -19,44 +21,51 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from qfedx_tpu.ops.gates import CDTYPE
+from qfedx_tpu.ops.cpx import CArray, RDTYPE
 from qfedx_tpu.ops.statevector import product_state
 
 
-def angle_amplitudes(angles: jnp.ndarray, basis: str = "ry") -> jnp.ndarray:
+def angle_amplitudes(angles: jnp.ndarray, basis: str = "ry") -> CArray:
     """Per-qubit 2-vectors for R_basis(angle)|0⟩; angles shape (n,) → (n, 2)."""
     half = angles / 2.0
     c, s = jnp.cos(half), jnp.sin(half)
     if basis == "ry":
-        return jnp.stack([c, s], axis=-1).astype(CDTYPE)
+        # RY(θ)|0⟩ = [cos θ/2, sin θ/2] — real.
+        return CArray(jnp.stack([c, s], axis=-1), None)
     if basis == "rx":
-        return jnp.stack([c.astype(CDTYPE), -1j * s.astype(CDTYPE)], axis=-1)
+        # RX(θ)|0⟩ = [cos θ/2, −i sin θ/2].
+        zero = jnp.zeros_like(c)
+        return CArray(
+            jnp.stack([c, zero], axis=-1), jnp.stack([zero, -s], axis=-1)
+        )
     if basis == "rz":
-        # RZ|0⟩ = e^{-iθ/2}|0⟩ — a pure phase, kept for API parity with the
-        # reference's basis option (qAngle.py:45-50).
-        phase = jnp.exp(-1j * half.astype(CDTYPE))
-        return jnp.stack([phase, jnp.zeros_like(phase)], axis=-1)
+        # RZ(θ)|0⟩ = e^{−iθ/2}|0⟩ — a pure phase, kept for API parity with
+        # the reference's basis option (qAngle.py:45-50).
+        zero = jnp.zeros_like(c)
+        return CArray(
+            jnp.stack([c, zero], axis=-1), jnp.stack([-s, zero], axis=-1)
+        )
     raise ValueError(f"unknown basis {basis!r}")
 
 
-def angle_encode(features: jnp.ndarray, basis: str = "ry") -> jnp.ndarray:
+def angle_encode(features: jnp.ndarray, basis: str = "ry") -> CArray:
     """Features in [0,1], shape (n,) → state (2,)*n via R(π·f_k) per qubit."""
     angles = features * jnp.pi
     return product_state(angle_amplitudes(angles, basis))
 
 
-def amplitude_encode(x: jnp.ndarray) -> jnp.ndarray:
-    """x of length 2^n → normalized state of shape (2,)*n.
+def amplitude_encode(x: jnp.ndarray) -> CArray:
+    """x of length 2^n → normalized real state of shape (2,)*n.
 
     All-zero input falls back to the uniform superposition (reference
     qAmplitude.py:17-21), expressed branch-free so it vmaps/jits.
     """
-    x = jnp.asarray(x)
+    x = jnp.asarray(x, dtype=RDTYPE)
     size = x.shape[-1]
     n = size.bit_length() - 1
     if 1 << n != size:
         raise ValueError(f"amplitude encoding needs 2^n features, got {size}")
     norm = jnp.linalg.norm(x)
-    uniform = jnp.full((size,), 1.0 / jnp.sqrt(size), dtype=x.dtype)
+    uniform = jnp.full((size,), 1.0 / jnp.sqrt(size), dtype=RDTYPE)
     safe = jnp.where(norm > 0, x / jnp.where(norm > 0, norm, 1.0), uniform)
-    return safe.astype(CDTYPE).reshape((2,) * n)
+    return CArray(safe.reshape((2,) * n), None)

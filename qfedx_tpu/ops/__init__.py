@@ -1,4 +1,5 @@
 from qfedx_tpu.ops import gates  # noqa: F401
+from qfedx_tpu.ops.cpx import CArray, from_complex, to_complex  # noqa: F401
 from qfedx_tpu.ops.statevector import (  # noqa: F401
     apply_gate,
     apply_gate_2q,

@@ -1,12 +1,15 @@
-"""Quantum gate library as JAX arrays.
+"""Quantum gate library as real-pair (CArray) tensors.
 
 The compute-path replacement for the reference's Qiskit circuit objects
 (reference src/QFed/qAngle.py:44-51 builds `QuantumCircuit`s gate by gate;
-src/QFed/qAmplitude.py:44-46 simulates them densely). Here a gate is just a
-complex64 matrix — (2,2) single-qubit, (2,2,2,2) two-qubit tensor — applied
-to a statevector by tensor contraction in `ops.statevector`. Rotation gates
-are traced functions of their (real) angle so the whole circuit is
-differentiable with `jax.grad` and fuses under XLA.
+src/QFed/qAmplitude.py:44-46 simulates them densely). A gate is a ``CArray``
+— (2,2) single-qubit or (2,2,2,2) two-qubit — applied by tensor contraction
+in `ops.statevector`. TPU has no complex dtype, so gates carry explicit
+(re, im) parts; known-real gates (RY, H, X, Z, CNOT, CZ, SWAP) set
+``im=None`` and skip half the contraction work at trace time.
+
+Rotation gates are traced functions of their real angle, so circuits are
+end-to-end differentiable with ``jax.grad``.
 
 Convention: qubit k is axis k of the state tensor of shape (2,)*n; for
 two-qubit tensors G[out1, out2, in1, in2], index 1 is the control where
@@ -16,66 +19,85 @@ applicable.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
-CDTYPE = jnp.complex64
+from qfedx_tpu.ops.cpx import CArray, RDTYPE, from_complex
 
-I2 = jnp.eye(2, dtype=CDTYPE)
-X = jnp.array([[0, 1], [1, 0]], dtype=CDTYPE)
-Y = jnp.array([[0, -1j], [1j, 0]], dtype=CDTYPE)
-Z = jnp.array([[1, 0], [0, -1]], dtype=CDTYPE)
-H = jnp.array([[1, 1], [1, -1]], dtype=CDTYPE) / jnp.sqrt(2).astype(CDTYPE)
-S = jnp.array([[1, 0], [0, 1j]], dtype=CDTYPE)
-T = jnp.array([[1, 0], [0, jnp.exp(1j * jnp.pi / 4)]], dtype=CDTYPE)
+# --- fixed gates (CArray constants) ---------------------------------------
 
-# Two-qubit gates as (2,2,2,2) tensors: G[o1, o2, i1, i2], qubit 1 = control.
-CNOT = jnp.array(
-    [[[[1, 0], [0, 0]], [[0, 1], [0, 0]]], [[[0, 0], [0, 1]], [[0, 0], [1, 0]]]],
-    dtype=CDTYPE,
+I2 = CArray(jnp.eye(2, dtype=RDTYPE), None)
+X = CArray(jnp.array([[0, 1], [1, 0]], dtype=RDTYPE), None)
+Y = CArray(
+    jnp.zeros((2, 2), dtype=RDTYPE),
+    jnp.array([[0, -1], [1, 0]], dtype=RDTYPE),
 )
-CZ = jnp.array(
-    [[[[1, 0], [0, 0]], [[0, 1], [0, 0]]], [[[0, 0], [1, 0]], [[0, 0], [0, -1]]]],
-    dtype=CDTYPE,
+Z = CArray(jnp.array([[1, 0], [0, -1]], dtype=RDTYPE), None)
+H = CArray(jnp.array([[1, 1], [1, -1]], dtype=RDTYPE) / np.sqrt(2), None)
+S = CArray(
+    jnp.array([[1, 0], [0, 0]], dtype=RDTYPE),
+    jnp.array([[0, 0], [0, 1]], dtype=RDTYPE),
 )
-SWAP = jnp.array(
-    [[[[1, 0], [0, 0]], [[0, 0], [1, 0]]], [[[0, 1], [0, 0]], [[0, 0], [0, 1]]]],
-    dtype=CDTYPE,
-)
+T = from_complex(np.diag([1.0, np.exp(1j * np.pi / 4)]))
+
+_CNOT_NP = np.zeros((2, 2, 2, 2))
+for _c in range(2):
+    for _t in range(2):
+        _CNOT_NP[_c, _t ^ _c, _c, _t] = 1.0
+CNOT = CArray(jnp.asarray(_CNOT_NP, dtype=RDTYPE), None)
+
+_CZ_NP = np.zeros((2, 2, 2, 2))
+for _c in range(2):
+    for _t in range(2):
+        _CZ_NP[_c, _t, _c, _t] = -1.0 if (_c == 1 and _t == 1) else 1.0
+CZ = CArray(jnp.asarray(_CZ_NP, dtype=RDTYPE), None)
+
+_SWAP_NP = np.zeros((2, 2, 2, 2))
+for _a in range(2):
+    for _b in range(2):
+        _SWAP_NP[_b, _a, _a, _b] = 1.0
+SWAP = CArray(jnp.asarray(_SWAP_NP, dtype=RDTYPE), None)
 
 
-def rx(theta) -> jnp.ndarray:
-    """RX(θ) = exp(-i θ X / 2); θ may be a traced scalar."""
-    c = jnp.cos(theta / 2).astype(CDTYPE)
-    s = (-1j * jnp.sin(theta / 2)).astype(CDTYPE)
-    return jnp.stack(
-        [jnp.stack([c, s]), jnp.stack([s, c])]
-    )
+# --- rotation gates (traced functions of a real angle) --------------------
 
 
-def ry(theta) -> jnp.ndarray:
-    """RY(θ) = exp(-i θ Y / 2)."""
-    c = jnp.cos(theta / 2).astype(CDTYPE)
-    s = jnp.sin(theta / 2).astype(CDTYPE)
-    return jnp.stack([jnp.stack([c, -s]), jnp.stack([s, c])])
+def rx(theta) -> CArray:
+    """RX(θ) = exp(-i θ X / 2) = [[c, -is], [-is, c]]."""
+    theta = jnp.asarray(theta, dtype=RDTYPE)
+    c, s = jnp.cos(theta / 2), jnp.sin(theta / 2)
+    zero = jnp.zeros_like(c)
+    re = jnp.stack([jnp.stack([c, zero]), jnp.stack([zero, c])])
+    im = jnp.stack([jnp.stack([zero, -s]), jnp.stack([-s, zero])])
+    return CArray(re, im)
 
 
-def rz(theta) -> jnp.ndarray:
-    """RZ(θ) = exp(-i θ Z / 2)."""
-    t = jnp.asarray(theta).astype(CDTYPE)
-    e_neg = jnp.exp(-0.5j * t)
-    e_pos = jnp.exp(0.5j * t)
-    zero = jnp.zeros((), dtype=CDTYPE)
-    return jnp.stack([jnp.stack([e_neg, zero]), jnp.stack([zero, e_pos])])
+def ry(theta) -> CArray:
+    """RY(θ) = exp(-i θ Y / 2) = [[c, -s], [s, c]] — purely real."""
+    theta = jnp.asarray(theta, dtype=RDTYPE)
+    c, s = jnp.cos(theta / 2), jnp.sin(theta / 2)
+    return CArray(jnp.stack([jnp.stack([c, -s]), jnp.stack([s, c])]), None)
+
+
+def rz(theta) -> CArray:
+    """RZ(θ) = diag(e^{-iθ/2}, e^{iθ/2})."""
+    theta = jnp.asarray(theta, dtype=RDTYPE)
+    c, s = jnp.cos(theta / 2), jnp.sin(theta / 2)
+    zero = jnp.zeros_like(c)
+    re = jnp.stack([jnp.stack([c, zero]), jnp.stack([zero, c])])
+    im = jnp.stack([jnp.stack([-s, zero]), jnp.stack([zero, s])])
+    return CArray(re, im)
 
 
 ROTATIONS = {"rx": rx, "ry": ry, "rz": rz}
 
 
-def crz(theta) -> jnp.ndarray:
+def crz(theta) -> CArray:
     """Controlled-RZ as a (2,2,2,2) tensor (control = first index pair)."""
-    g = jnp.zeros((2, 2, 2, 2), dtype=CDTYPE)
-    g = g.at[0, 0, 0, 0].set(1.0)
-    g = g.at[0, 1, 0, 1].set(1.0)
-    r = rz(theta)
-    g = g.at[1, 0, 1, 0].set(r[0, 0])
-    g = g.at[1, 1, 1, 1].set(r[1, 1])
-    return g
+    theta = jnp.asarray(theta, dtype=RDTYPE)
+    c, s = jnp.cos(theta / 2), jnp.sin(theta / 2)
+    re = jnp.zeros((2, 2, 2, 2), dtype=RDTYPE)
+    re = re.at[0, 0, 0, 0].set(1.0).at[0, 1, 0, 1].set(1.0)
+    re = re.at[1, 0, 1, 0].set(c).at[1, 1, 1, 1].set(c)
+    im = jnp.zeros((2, 2, 2, 2), dtype=RDTYPE)
+    im = im.at[1, 0, 1, 0].set(-s).at[1, 1, 1, 1].set(s)
+    return CArray(re, im)

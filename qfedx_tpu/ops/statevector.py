@@ -1,91 +1,119 @@
-"""Dense statevector simulation engine (single device).
+"""Dense statevector simulation engine (single device, real-pair form).
 
 The TPU-native replacement for the reference's entire quantum backend —
 Qiskit's `Statevector.from_instruction` one-liner (reference
 src/QFed/qAmplitude.py:44-46). Design (SURVEY.md §7.1.1):
 
-- State = complex64 tensor of shape ``(2,)*n``; qubit k is axis k.
-- Gates = small tensors contracted onto target axes with ``jnp.tensordot``
-  — XLA lowers these to batched matmuls on the MXU and fuses adjacent
-  elementwise work.
+- State = ``CArray`` (re, im float32 pair — TPU has no complex dtype; see
+  ops.cpx) of shape ``(2,)*n``; qubit k is axis k.
+- Gates contract onto target axes with ``jnp.tensordot`` — XLA lowers these
+  to batched matmuls on the MXU and fuses adjacent elementwise work. A
+  complex gate application is ≤4 real contractions; known-real gates/states
+  skip the missing parts at trace time.
 - Batching over samples is ``jax.vmap``; everything is jit-compatible with
   static circuit structure (qubit indices are Python ints at trace time).
 - Gradients flow through the simulation with ``jax.grad`` (the framework's
   default differentiation; parameter-shift is kept as a cross-check in
   ``circuits.gradients``, per reference ROADMAP.md:27,131-135).
 
-Memory is O(2^n) per state; the device-sharded engine in ``ops.sharded``
-extends this past single-chip HBM (reference ROADMAP.md:86 caps dense
-statevector at 20 qubits — sharding is how we hit that scale and beyond).
+Memory is O(2·4·2^n) bytes per state; the device-sharded engine in
+``ops.sharded`` extends past single-chip HBM (reference ROADMAP.md:86 caps
+dense statevector at 20 qubits — sharding is how we reach that and beyond).
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-from qfedx_tpu.ops.gates import CDTYPE
+from qfedx_tpu.ops.cpx import CArray, RDTYPE, cabs2, vdot
 
 
-def zero_state(n_qubits: int) -> jnp.ndarray:
-    """|0...0⟩ as a (2,)*n tensor."""
-    state = jnp.zeros((2,) * n_qubits, dtype=CDTYPE)
-    return state.reshape(-1).at[0].set(1.0).reshape((2,) * n_qubits)
+def zero_state(n_qubits: int) -> CArray:
+    """|0...0⟩ as a (2,)*n CArray (real)."""
+    re = jnp.zeros((2,) * n_qubits, dtype=RDTYPE)
+    re = re.reshape(-1).at[0].set(1.0).reshape((2,) * n_qubits)
+    return CArray(re, None)
 
 
-def product_state(amps: jnp.ndarray) -> jnp.ndarray:
+def product_state(amps: CArray) -> CArray:
     """Tensor product of per-qubit 2-vectors; amps shape (n, 2) → (2,)*n.
 
     Used by the angle encoder: a bank of single-qubit rotations on |0⟩ is a
-    product state, so we build it directly in O(2^n) *memory writes* with no
-    sequential gate applications at all.
+    product state, built directly with outer products — no sequential gate
+    applications at all. Real inputs stay real the whole way.
     """
     n = amps.shape[0]
-    state = amps[0].astype(CDTYPE)
+
+    def outer(a: CArray, b: CArray) -> CArray:
+        rr = jnp.tensordot(a.re, b.re, axes=0)
+        if a.im is None and b.im is None:
+            return CArray(rr, None)
+        a_im = a.imag_or_zeros()
+        b_im = b.imag_or_zeros()
+        return CArray(
+            rr - jnp.tensordot(a_im, b_im, axes=0),
+            jnp.tensordot(a.re, b_im, axes=0) + jnp.tensordot(a_im, b.re, axes=0),
+        )
+
+    qubit = lambda k: CArray(amps.re[k], None if amps.im is None else amps.im[k])
+    state = qubit(0)
     for k in range(1, n):
-        state = jnp.tensordot(state, amps[k].astype(CDTYPE), axes=0)
+        state = outer(state, qubit(k))
     return state
 
 
-def apply_gate(state: jnp.ndarray, gate: jnp.ndarray, qubit: int) -> jnp.ndarray:
+def _contract_move(g: jnp.ndarray, s: jnp.ndarray, axes, src, dst) -> jnp.ndarray:
+    return jnp.moveaxis(jnp.tensordot(g, s, axes=axes), src, dst)
+
+
+def _apply(gate: CArray, state: CArray, axes, src, dst) -> CArray:
+    """out = G·ψ with the four real-contraction cases resolved at trace time."""
+    rr = _contract_move(gate.re, state.re, axes, src, dst)
+    if gate.im is None and state.im is None:
+        return CArray(rr, None)
+    if gate.im is None:
+        return CArray(rr, _contract_move(gate.re, state.im, axes, src, dst))
+    if state.im is None:
+        return CArray(rr, _contract_move(gate.im, state.re, axes, src, dst))
+    return CArray(
+        rr - _contract_move(gate.im, state.im, axes, src, dst),
+        _contract_move(gate.re, state.im, axes, src, dst)
+        + _contract_move(gate.im, state.re, axes, src, dst),
+    )
+
+
+def apply_gate(state: CArray, gate: CArray, qubit: int) -> CArray:
     """Apply a (2,2) gate to axis ``qubit`` of a (2,)*n state."""
-    out = jnp.tensordot(gate, state, axes=((1,), (qubit,)))
-    return jnp.moveaxis(out, 0, qubit)
+    return _apply(gate, state, ((1,), (qubit,)), 0, qubit)
 
 
-def apply_gate_2q(
-    state: jnp.ndarray, gate: jnp.ndarray, q1: int, q2: int
-) -> jnp.ndarray:
+def apply_gate_2q(state: CArray, gate: CArray, q1: int, q2: int) -> CArray:
     """Apply a (2,2,2,2) gate tensor G[o1,o2,i1,i2] to axes (q1, q2)."""
-    out = jnp.tensordot(gate, state, axes=((2, 3), (q1, q2)))
-    return jnp.moveaxis(out, (0, 1), (q1, q2))
+    return _apply(gate, state, ((2, 3), (q1, q2)), (0, 1), (q1, q2))
 
 
-def probabilities(state: jnp.ndarray) -> jnp.ndarray:
+def probabilities(state: CArray) -> jnp.ndarray:
     """|ψ|² flattened to (2^n,) in big-endian qubit order."""
-    return jnp.square(jnp.abs(state)).reshape(-1)
+    return cabs2(state).reshape(-1)
 
 
-def expect_z(state: jnp.ndarray, qubit: int) -> jnp.ndarray:
+def expect_z(state: CArray, qubit: int) -> jnp.ndarray:
     """⟨Z_qubit⟩ = P(qubit=0) − P(qubit=1), real scalar.
 
     The readout primitive: reference ROADMAP.md:128 maps ⟨Z⟩ → logit.
     """
-    probs = jnp.square(jnp.abs(state))
-    n = state.ndim
+    probs = cabs2(state)
+    n = probs.ndim
     z = jnp.array([1.0, -1.0], dtype=probs.dtype).reshape(
         (1,) * qubit + (2,) + (1,) * (n - qubit - 1)
     )
     return jnp.sum(probs * z)
 
 
-def expect_z_all(state: jnp.ndarray) -> jnp.ndarray:
-    """⟨Z_k⟩ for every qubit k at once, shape (n,).
-
-    One pass over |ψ|² instead of n separate reductions — the hot readout
-    path when logits use several qubits.
-    """
-    probs = jnp.square(jnp.abs(state))
-    n = state.ndim
+def expect_z_all(state: CArray) -> jnp.ndarray:
+    """⟨Z_k⟩ for every qubit k at once, shape (n,)."""
+    probs = cabs2(state)
+    n = probs.ndim
     out = []
     for k in range(n):
         axes = tuple(i for i in range(n) if i != k)
@@ -94,7 +122,10 @@ def expect_z_all(state: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack(out)
 
 
-def fidelity(state_a: jnp.ndarray, state_b: jnp.ndarray) -> jnp.ndarray:
+def fidelity(state_a: CArray, state_b: CArray) -> jnp.ndarray:
     """|⟨a|b⟩|² — the quantum-kernel primitive (BASELINE.md config 5)."""
-    overlap = jnp.sum(jnp.conj(state_a) * state_b)
-    return jnp.square(jnp.abs(overlap))
+    overlap = vdot(state_a, state_b)
+    out = jnp.square(overlap.re)
+    if overlap.im is not None:
+        out = out + jnp.square(overlap.im)
+    return out

@@ -16,6 +16,7 @@ from qfedx_tpu.circuits.encoders import amplitude_encode, angle_encode
 from qfedx_tpu.circuits.gradients import param_shift_grad, param_shift_grad_pytree
 from qfedx_tpu.circuits.readout import init_readout_params, z_logits
 from qfedx_tpu.ops import gates
+from qfedx_tpu.ops.cpx import to_complex
 from qfedx_tpu.ops.statevector import apply_gate, expect_z, probabilities, zero_state
 
 
@@ -25,7 +26,7 @@ def test_angle_encode_matches_gate_application():
     seq = zero_state(4)
     for q in range(4):
         seq = apply_gate(seq, gates.ry(feats[q] * jnp.pi), q)
-    np.testing.assert_allclose(np.asarray(state), np.asarray(seq), atol=1e-6)
+    np.testing.assert_allclose(to_complex(state), to_complex(seq), atol=1e-6)
     # f=0 → |0⟩ (⟨Z⟩=1), f=1 → |1⟩ (⟨Z⟩=-1), f=0.5 → equator (⟨Z⟩=0)
     assert float(expect_z(state, 0)) == pytest.approx(1.0, abs=1e-6)
     assert float(expect_z(state, 3)) == pytest.approx(-1.0, abs=1e-6)
@@ -43,7 +44,7 @@ def test_amplitude_encode_normalizes():
     x = jnp.array([3.0, 0.0, 0.0, 4.0])
     state = amplitude_encode(x)
     np.testing.assert_allclose(
-        np.asarray(state.reshape(-1)), [0.6, 0, 0, 0.8], atol=1e-6
+        to_complex(state).reshape(-1), [0.6, 0, 0, 0.8], atol=1e-6
     )
 
 
@@ -72,7 +73,7 @@ def test_hardware_efficient_unit_norm_and_entangles():
     assert float(jnp.sum(probabilities(state))) == pytest.approx(1.0, abs=1e-5)
     # Entangled in general: state should not factor as a product — check via
     # purity of the 1-qubit reduced density matrix < 1.
-    full = np.asarray(state).reshape(2, 8)
+    full = to_complex(state).reshape(2, 8)
     rho = full @ full.conj().T
     purity = float(np.real(np.trace(rho @ rho)))
     assert purity < 0.999
@@ -84,7 +85,7 @@ def test_data_reuploading_runs_and_depends_on_input():
     s1 = data_reuploading(jnp.array([0.1, 0.2, 0.3]), params)
     s2 = data_reuploading(jnp.array([0.9, 0.8, 0.7]), params)
     assert float(jnp.sum(probabilities(s1))) == pytest.approx(1.0, abs=1e-5)
-    assert not np.allclose(np.asarray(s1), np.asarray(s2), atol=1e-3)
+    assert not np.allclose(to_complex(s1), to_complex(s2), atol=1e-3)
 
 
 def test_readout_shapes_and_bounds():

@@ -1,4 +1,8 @@
-"""Statevector engine correctness vs. dense linear algebra ground truth."""
+"""Statevector engine correctness vs. dense linear algebra ground truth.
+
+The engine stores states as real (re, im) pairs (TPU has no complex dtype);
+ground truth here is ordinary numpy complex linear algebra via kron.
+"""
 
 import numpy as np
 import jax
@@ -6,6 +10,7 @@ import jax.numpy as jnp
 import pytest
 
 from qfedx_tpu.ops import gates
+from qfedx_tpu.ops.cpx import CArray, from_complex, to_complex
 from qfedx_tpu.ops.statevector import (
     apply_gate,
     apply_gate_2q,
@@ -16,6 +21,14 @@ from qfedx_tpu.ops.statevector import (
     product_state,
     zero_state,
 )
+
+
+def gate_matrix(g: CArray) -> np.ndarray:
+    """CArray gate → dense complex matrix (4×4 for two-qubit tensors)."""
+    m = to_complex(g)
+    if m.ndim == 4:
+        return m.reshape(4, 4)
+    return m
 
 
 def dense_1q(gate: np.ndarray, qubit: int, n: int) -> np.ndarray:
@@ -35,97 +48,144 @@ def rand_state(n, seed=0):
     return v.astype(np.complex64)
 
 
+def as_cstate(psi: np.ndarray, n: int) -> CArray:
+    return from_complex(psi.reshape((2,) * n))
+
+
 def test_rotation_gates_match_closed_form():
     theta = 0.7321
     np.testing.assert_allclose(
-        np.asarray(gates.rx(theta)),
-        np.cos(theta / 2) * np.eye(2) - 1j * np.sin(theta / 2) * np.array([[0, 1], [1, 0]]),
+        gate_matrix(gates.rx(theta)),
+        np.cos(theta / 2) * np.eye(2)
+        - 1j * np.sin(theta / 2) * np.array([[0, 1], [1, 0]]),
         atol=1e-6,
     )
     np.testing.assert_allclose(
-        np.asarray(gates.ry(theta)),
+        gate_matrix(gates.ry(theta)),
         [[np.cos(theta / 2), -np.sin(theta / 2)], [np.sin(theta / 2), np.cos(theta / 2)]],
         atol=1e-6,
     )
     np.testing.assert_allclose(
-        np.asarray(gates.rz(theta)),
+        gate_matrix(gates.rz(theta)),
         np.diag([np.exp(-0.5j * theta), np.exp(0.5j * theta)]),
         atol=1e-6,
     )
+    # real-only fast paths: ry is real, rx/rz are not
+    assert gates.ry(theta).im is None
+    assert gates.rx(theta).im is not None and gates.rz(theta).im is not None
 
 
-@pytest.mark.parametrize("name", ["X", "Y", "Z", "H", "S", "T"])
+@pytest.mark.parametrize("name", ["X", "Y", "Z", "H", "S", "T", "CNOT", "CZ", "SWAP"])
 def test_fixed_gates_unitary(name):
-    g = np.asarray(getattr(gates, name))
-    np.testing.assert_allclose(g @ g.conj().T, np.eye(2), atol=1e-6)
+    g = gate_matrix(getattr(gates, name))
+    np.testing.assert_allclose(g @ g.conj().T, np.eye(g.shape[0]), atol=1e-6)
 
 
-def test_apply_gate_matches_dense():
+def test_crz_matches_dense():
+    theta = 1.234
+    got = gate_matrix(gates.crz(theta))
+    want = np.diag([1, 1, np.exp(-0.5j * theta), np.exp(0.5j * theta)])
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("gname", ["H", "Y", "S"])
+def test_apply_gate_matches_dense(gname):
     n = 4
     psi = rand_state(n, seed=1)
-    state = jnp.asarray(psi).reshape((2,) * n)
+    state = as_cstate(psi, n)
+    g = getattr(gates, gname)
     for q in range(n):
-        got = apply_gate(state, gates.H, q).reshape(-1)
-        want = dense_1q(np.asarray(gates.H), q, n) @ psi
-        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+        got = to_complex(apply_gate(state, g, q)).reshape(-1)
+        want = dense_1q(gate_matrix(g), q, n) @ psi
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_apply_rotation_to_real_state_stays_consistent():
+    """Real state + complex gate exercises the mixed contraction path."""
+    n = 3
+    psi = np.zeros(8, dtype=np.complex64)
+    psi[3] = 1.0
+    state = CArray(jnp.asarray(psi.real.reshape(2, 2, 2)), None)
+    got = to_complex(apply_gate(state, gates.rx(0.9), 1)).reshape(-1)
+    want = dense_1q(gate_matrix(gates.rx(0.9)), 1, n) @ psi
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def _cnot_dense(control: int, target: int, n: int) -> np.ndarray:
+    dim = 2**n
+    mat = np.zeros((dim, dim))
+    for i in range(dim):
+        bits = [(i >> (n - 1 - k)) & 1 for k in range(n)]
+        if bits[control] == 1:
+            bits[target] ^= 1
+        j = sum(b << (n - 1 - k) for k, b in enumerate(bits))
+        mat[j, i] = 1.0
+    return mat
 
 
 def test_apply_gate_2q_matches_dense_cnot():
-    # CNOT on (control=0, target=1) for 3 qubits, big-endian axis order.
     n = 3
     psi = rand_state(n, seed=2)
-    state = jnp.asarray(psi).reshape((2,) * n)
-    got = apply_gate_2q(state, gates.CNOT, 0, 1).reshape(-1)
-    cnot01 = np.zeros((8, 8))
-    for i in range(8):
-        b = [(i >> 2) & 1, (i >> 1) & 1, i & 1]
-        if b[0] == 1:
-            b[1] ^= 1
-        j = (b[0] << 2) | (b[1] << 1) | b[2]
-        cnot01[j, i] = 1.0
-    np.testing.assert_allclose(np.asarray(got), cnot01 @ psi, atol=1e-5)
+    state = as_cstate(psi, n)
+    got = to_complex(apply_gate_2q(state, gates.CNOT, 0, 1)).reshape(-1)
+    np.testing.assert_allclose(got, _cnot_dense(0, 1, n) @ psi, atol=1e-5)
 
 
 def test_apply_gate_2q_nonadjacent_and_reversed():
     n = 3
     psi = rand_state(n, seed=3)
-    state = jnp.asarray(psi).reshape((2,) * n)
-    # control=2, target=0
-    got = apply_gate_2q(state, gates.CNOT, 2, 0).reshape(-1)
-    mat = np.zeros((8, 8))
-    for i in range(8):
-        b = [(i >> 2) & 1, (i >> 1) & 1, i & 1]
-        if b[2] == 1:
-            b[0] ^= 1
-        j = (b[0] << 2) | (b[1] << 1) | b[2]
-        mat[j, i] = 1.0
-    np.testing.assert_allclose(np.asarray(got), mat @ psi, atol=1e-5)
+    state = as_cstate(psi, n)
+    got = to_complex(apply_gate_2q(state, gates.CNOT, 2, 0)).reshape(-1)
+    np.testing.assert_allclose(got, _cnot_dense(2, 0, n) @ psi, atol=1e-5)
+
+
+def test_crz_2q_application_matches_dense():
+    n = 3
+    psi = rand_state(n, seed=4)
+    state = as_cstate(psi, n)
+    theta = 0.77
+    got = to_complex(apply_gate_2q(state, gates.crz(theta), 1, 2)).reshape(-1)
+    ops = np.kron(np.eye(2), gate_matrix(gates.crz(theta)))
+    np.testing.assert_allclose(got, ops @ psi, atol=1e-5)
 
 
 def test_zero_state_and_probabilities():
     s = zero_state(3)
+    assert s.im is None  # real fast path
     p = probabilities(s)
-    assert p.shape == (8,)
     np.testing.assert_allclose(np.asarray(p), [1, 0, 0, 0, 0, 0, 0, 0], atol=1e-7)
 
 
 def test_product_state_matches_sequential_gates():
     angles = jnp.array([0.3, 1.1, 2.0])
-    amps = jnp.stack([jnp.cos(angles / 2), jnp.sin(angles / 2)], axis=-1)
-    direct = product_state(amps.astype(jnp.complex64))
+    amps = CArray(jnp.stack([jnp.cos(angles / 2), jnp.sin(angles / 2)], axis=-1), None)
+    direct = product_state(amps)
+    assert direct.im is None  # real stays real
     seq = zero_state(3)
     for q in range(3):
         seq = apply_gate(seq, gates.ry(angles[q]), q)
-    np.testing.assert_allclose(np.asarray(direct), np.asarray(seq), atol=1e-6)
+    np.testing.assert_allclose(to_complex(direct), to_complex(seq), atol=1e-6)
+
+
+def test_product_state_complex_amps():
+    """rx-encoded qubits are complex; product must match gate application."""
+    angles = jnp.array([0.5, 1.3])
+    seq = zero_state(2)
+    for q in range(2):
+        seq = apply_gate(seq, gates.rx(angles[q]), q)
+    from qfedx_tpu.circuits.encoders import angle_amplitudes
+
+    direct = product_state(angle_amplitudes(angles / jnp.pi * jnp.pi, "rx"))
+    np.testing.assert_allclose(to_complex(direct), to_complex(seq), atol=1e-6)
 
 
 def test_expect_z_values():
     s = zero_state(2)
-    assert np.asarray(expect_z(s, 0)) == pytest.approx(1.0)
+    assert float(expect_z(s, 0)) == pytest.approx(1.0)
     s = apply_gate(s, gates.X, 1)
-    assert np.asarray(expect_z(s, 1)) == pytest.approx(-1.0)
+    assert float(expect_z(s, 1)) == pytest.approx(-1.0)
     s = apply_gate(s, gates.H, 0)
-    assert np.asarray(expect_z(s, 0)) == pytest.approx(0.0, abs=1e-6)
+    assert float(expect_z(s, 0)) == pytest.approx(0.0, abs=1e-6)
     np.testing.assert_allclose(np.asarray(expect_z_all(s)), [0.0, -1.0], atol=1e-6)
 
 
@@ -133,7 +193,7 @@ def test_state_norm_preserved_through_circuit():
     state = zero_state(4)
     key = jax.random.PRNGKey(0)
     for q in range(4):
-        state = apply_gate(state, gates.ry(jax.random.uniform(jax.random.fold_in(key, q))), q)
+        state = apply_gate(state, gates.rx(jax.random.uniform(jax.random.fold_in(key, q))), q)
     for q in range(3):
         state = apply_gate_2q(state, gates.CNOT, q, q + 1)
     assert float(jnp.sum(probabilities(state))) == pytest.approx(1.0, abs=1e-5)
@@ -144,6 +204,12 @@ def test_fidelity_self_and_orthogonal():
     b = apply_gate(zero_state(2), gates.X, 0)
     assert float(fidelity(a, a)) == pytest.approx(1.0, abs=1e-6)
     assert float(fidelity(a, b)) == pytest.approx(0.0, abs=1e-6)
+    # phase-insensitive: global phase from rz must not change fidelity
+    c = apply_gate(a, gates.rz(1.1), 0)
+    assert float(fidelity(a, c)) == pytest.approx(
+        float(np.abs(np.vdot(to_complex(a).reshape(-1), to_complex(c).reshape(-1))) ** 2),
+        abs=1e-6,
+    )
 
 
 def test_engine_jits_and_vmaps():
@@ -157,5 +223,4 @@ def test_engine_jits_and_vmaps():
     thetas = jnp.array([[0.1, 0.2, 0.3], [1.0, 1.1, 1.2]])
     out = jax.jit(jax.vmap(circuit))(thetas)
     assert out.shape == (2,)
-    single = circuit(thetas[0])
-    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(single), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(circuit(thetas[0])), atol=1e-6)
