@@ -1,0 +1,167 @@
+"""Driver benchmark: VQC client-rounds/sec/chip (BASELINE.md north star).
+
+Prints ONE JSON line:
+    {"metric": "vqc_client_rounds_per_sec_per_chip", "value": N,
+     "unit": "client-rounds/s/chip", "vs_baseline": R}
+
+``value``: flagship 8-qubit VQC federated round — one jitted SPMD program
+(shard_map + psum over a client mesh axis) — measured as
+(clients x rounds) / wall-clock / chips.
+
+``vs_baseline``: speedup vs the reference's architecture on the SAME
+hardware, model, and config: a sequential per-client Python loop with host
+aggregation (reference src/CFed/Classical_FL.py:128-147), with each client's
+local update individually jitted (which is *generous* to the baseline — the
+reference ran eager torch). The reference publishes no numbers of its own
+(BASELINE.md), so the architectural baseline is measured here, in the same
+process, on the same chip.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _build():
+    import jax
+
+    from qfedx_tpu.fed.client import make_local_update
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.fed.round import client_mesh, make_fed_round, shard_client_data
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    # Flagship config: 8-qubit, 3-layer VQC; reference training hyperparams
+    # (5 local epochs, batch 32 — src/CFed/Classical_FL.py:40-53).
+    n_qubits, n_layers = 8, 3
+    num_clients, samples = 8, 128
+    cfg = FedConfig(
+        local_epochs=5, batch_size=32, learning_rate=0.01, momentum=0.9
+    )
+    model = make_vqc_classifier(n_qubits=n_qubits, n_layers=n_layers, num_classes=2)
+
+    rng = np.random.default_rng(0)
+    cx = rng.uniform(0, 1, (num_clients, samples, n_qubits)).astype(np.float32)
+    cy = rng.integers(0, 2, (num_clients, samples)).astype(np.int32)
+    cmask = np.ones((num_clients, samples), dtype=np.float32)
+
+    n_dev = min(len(jax.devices()), num_clients)
+    while num_clients % n_dev != 0:
+        n_dev -= 1
+    mesh = client_mesh(num_devices=n_dev)
+    return (
+        jax,
+        model,
+        cfg,
+        mesh,
+        n_dev,
+        num_clients,
+        (cx, cy, cmask),
+        (make_fed_round, shard_client_data, make_local_update),
+    )
+
+
+def _time_spmd(jax, model, cfg, mesh, num_clients, data, make_fed_round,
+               shard_client_data, rounds=7):
+    cx, cy, cmask = data
+    round_fn = make_fed_round(model, cfg, mesh, num_clients=num_clients)
+    scx, scy, scm = shard_client_data(mesh, cx, cy, np.asarray(cmask))
+    params = model.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(1)
+    # Two warmup rounds: the first compiles for plain init params, the
+    # second for the NamedSharding-carrying params the round itself emits —
+    # the steady-state layout the timed loop runs with.
+    params, _ = round_fn(params, scx, scy, scm, key)
+    params, _ = round_fn(params, scx, scy, scm, key)
+    jax.block_until_ready(params)
+    times = []
+    for r in range(rounds):
+        key = jax.random.fold_in(key, r)
+        t0 = time.perf_counter()
+        params, _ = round_fn(params, scx, scy, scm, key)
+        jax.block_until_ready(params)
+        times.append(time.perf_counter() - t0)
+    # Median: robust to transient dispatch-latency spikes (tunneled TPU).
+    return sorted(times)[len(times) // 2]
+
+
+def _time_sequential(jax, model, cfg, num_clients, data, make_local_update,
+                     rounds=2):
+    """Reference architecture: per-client jitted update in a Python loop,
+    host-side weighted averaging (src/CFed/Classical_FL.py:128-147)."""
+    import jax.numpy as jnp
+
+    cx, cy, cmask = data
+    local_update = jax.jit(make_local_update(model, cfg))
+    params = model.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(1)
+
+    def one_round(params, key):
+        deltas, weights = [], []
+        for c in range(num_clients):
+            d, n, _ = local_update(
+                params, cx[c], cy[c], cmask[c], jax.random.fold_in(key, c)
+            )
+            deltas.append(d)
+            weights.append(n)
+        total = sum(float(w) for w in weights)
+        avg = jax.tree.map(
+            lambda *ls: sum(float(w) * l for w, l in zip(weights, ls)) / total,
+            *deltas,
+        )
+        return jax.tree.map(lambda p, u: p + u, params, avg)
+
+    params = one_round(params, key)  # warmup/compile
+    params = one_round(params, key)  # steady-state layout
+    jax.block_until_ready(params)
+    times = []
+    for r in range(rounds):
+        t0 = time.perf_counter()
+        params = one_round(params, jax.random.fold_in(key, r))
+        jax.block_until_ready(params)
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2]
+
+
+def main():
+    (jax, model, cfg, mesh, n_dev, num_clients, data, fns) = _build()
+    make_fed_round, shard_client_data, make_local_update = fns
+
+    spmd_s = _time_spmd(
+        jax, model, cfg, mesh, num_clients, data, make_fed_round, shard_client_data
+    )
+    seq_s = _time_sequential(jax, model, cfg, num_clients, data, make_local_update)
+
+    value = num_clients / spmd_s / n_dev
+    baseline_value = num_clients / seq_s / n_dev
+    print(
+        json.dumps(
+            {
+                "metric": "vqc_client_rounds_per_sec_per_chip",
+                "value": round(value, 3),
+                "unit": "client-rounds/s/chip",
+                "vs_baseline": round(value / baseline_value, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # never leave the driver without a JSON line
+        print(
+            json.dumps(
+                {
+                    "metric": "vqc_client_rounds_per_sec_per_chip",
+                    "value": 0.0,
+                    "unit": "client-rounds/s/chip",
+                    "vs_baseline": 0.0,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+            )
+        )
+        sys.exit(1)

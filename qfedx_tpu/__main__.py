@@ -1,0 +1,3 @@
+from qfedx_tpu.run.cli import main
+
+main()

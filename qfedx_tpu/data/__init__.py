@@ -5,3 +5,7 @@ from qfedx_tpu.data.partition import (  # noqa: F401
     pack_clients,
 )
 from qfedx_tpu.data.pipeline import preprocess  # noqa: F401
+from qfedx_tpu.data.viz import (  # noqa: F401
+    save_class_distribution,
+    save_client_samples,
+)

@@ -35,7 +35,38 @@ from qfedx_tpu.utils import trees
 def make_optimizer(cfg: FedConfig) -> optax.GradientTransformation:
     if cfg.optimizer == "adam":
         return optax.adam(cfg.learning_rate)
+    # SPSA estimates gradients (see make_local_update) but updates like SGD.
     return optax.sgd(cfg.learning_rate, momentum=cfg.momentum or None)
+
+
+def make_spsa_grad(loss_fn, c: float):
+    """SPSA: 2-evaluation simultaneous-perturbation gradient estimator
+    (reference ROADMAP.md:38's gradient-cost-reduction option).
+
+    ĝ = [L(θ+cΔ) − L(θ−cΔ)] / (2c) · Δ⁻¹ with Rademacher Δ (Δ⁻¹ = Δ).
+    Same (loss, grads) contract as jax.value_and_grad, keyed explicitly.
+    """
+
+    def spsa_grad(params, global_params, xb, yb, mb, key):
+        k_delta, k_fwd = jax.random.split(jax.random.fold_in(key, 0x59A))
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        dkeys = jax.random.split(k_delta, len(leaves))
+        deltas = jax.tree_util.tree_unflatten(
+            treedef,
+            [
+                jax.random.rademacher(k, l.shape, dtype=l.dtype)
+                for k, l in zip(dkeys, leaves)
+            ],
+        )
+        plus = jax.tree.map(lambda p, d: p + c * d, params, deltas)
+        minus = jax.tree.map(lambda p, d: p - c * d, params, deltas)
+        lp = loss_fn(plus, global_params, xb, yb, mb, k_fwd)
+        lm = loss_fn(minus, global_params, xb, yb, mb, k_fwd)
+        scale = (lp - lm) / (2.0 * c)
+        grads = jax.tree.map(lambda d: scale * d, deltas)
+        return (lp + lm) / 2.0, grads
+
+    return spsa_grad
 
 
 def make_local_update(model: Model, cfg: FedConfig) -> Callable:
@@ -59,7 +90,10 @@ def make_local_update(model: Model, cfg: FedConfig) -> Callable:
             loss = loss + 0.5 * cfg.prox_mu * prox
         return loss
 
-    grad_fn = jax.value_and_grad(loss_fn)
+    if cfg.optimizer == "spsa":
+        grad_fn = make_spsa_grad(loss_fn, cfg.spsa_c)
+    else:
+        grad_fn = jax.value_and_grad(loss_fn)
 
     def local_update(global_params, x, y, mask, key):
         x, y, mask = jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask)

@@ -28,7 +28,12 @@ class FedConfig:
     batch_size: int = 32
     learning_rate: float = 0.01
     momentum: float = 0.9
-    optimizer: str = "sgd"  # "sgd" | "adam" (ROADMAP.md:38 wants Adam too)
+    # "sgd" | "adam" | "spsa" (ROADMAP.md:38: Adam + SPSA option). SPSA is a
+    # 2-evaluation stochastic gradient *estimator* (the gradient-cost
+    # reduction the roadmap wants for shot-based hardware) driving an SGD
+    # update; spsa_c is its perturbation scale.
+    optimizer: str = "sgd"
+    spsa_c: float = 0.1
     algorithm: str = "fedavg"  # "fedavg" | "fedprox"
     prox_mu: float = 0.0  # FedProx proximal strength (BASELINE.md config 3)
     client_fraction: float = 1.0  # client sampling p (ROADMAP.md:106)
@@ -42,7 +47,7 @@ class FedConfig:
     def __post_init__(self):
         if self.algorithm not in ("fedavg", "fedprox"):
             raise ValueError(f"unknown algorithm {self.algorithm!r}")
-        if self.optimizer not in ("sgd", "adam"):
+        if self.optimizer not in ("sgd", "adam", "spsa"):
             raise ValueError(f"unknown optimizer {self.optimizer!r}")
         if self.algorithm == "fedprox" and self.prox_mu <= 0:
             raise ValueError("fedprox requires prox_mu > 0")

@@ -1,3 +1,8 @@
 from qfedx_tpu.models.api import Model  # noqa: F401
 from qfedx_tpu.models.vqc import make_vqc_classifier  # noqa: F401
 from qfedx_tpu.models.cnn import make_tiny_cnn  # noqa: F401
+from qfedx_tpu.models.kernel import (  # noqa: F401
+    init_landmarks_from_data,
+    kernel_matrix,
+    make_quantum_kernel_classifier,
+)

@@ -1,0 +1,91 @@
+"""Quantum-kernel classifier head (BASELINE.md config 5).
+
+A fidelity ("quantum") kernel k(x, x′) = |⟨φ(x)|φ(x′)⟩|² over the circuit's
+feature map φ, with a trainable linear head on kernel features against M
+learned (or data-chosen) landmark points — the primal form of kernel
+logistic regression, chosen over a dual SVM because it keeps the federated
+contract intact: parameters are a fixed-shape pytree (landmarks + weights),
+so the kernel model rides the same FedAvg/DP/secure-agg harness as the VQC
+and CNN (reference ROADMAP.md:109's apples-to-apples requirement; the
+reference itself has no kernel code — this implements the driver's config-5
+capability on the fidelity primitive ops.statevector.fidelity).
+
+Gram rows are one ``vmap`` over landmarks inside one ``vmap`` over the
+batch — 2^n-length dot products that XLA batches onto the MXU; no pairwise
+Python loops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from qfedx_tpu.circuits.encoders import angle_encode
+from qfedx_tpu.models.api import Model
+from qfedx_tpu.ops.cpx import CArray
+from qfedx_tpu.ops.statevector import fidelity
+
+
+def _feature_state(x: jnp.ndarray, basis: str) -> CArray:
+    return angle_encode(x, basis)
+
+
+def kernel_matrix(xs: jnp.ndarray, ys: jnp.ndarray, basis: str = "ry") -> jnp.ndarray:
+    """Gram matrix K[i, j] = |⟨φ(xs_i)|φ(ys_j)⟩|², shapes (B, n)×(M, n)→(B, M)."""
+    # Encode each side once (O((B+M)·2^n)), not per pair: the landmark
+    # states are reused across every batch row.
+    sy = jax.vmap(lambda y: _feature_state(y, basis))(ys)
+
+    def row(x):
+        sx = _feature_state(x, basis)
+        return jax.vmap(lambda s: fidelity(sx, s))(sy)
+
+    return jax.vmap(row)(xs)
+
+
+def make_quantum_kernel_classifier(
+    n_qubits: int,
+    n_landmarks: int = 16,
+    num_classes: int = 2,
+    basis: str = "ry",
+    landmark_scale: float = 1.0,
+) -> Model:
+    """Kernel head Model: logits = K(x, landmarks) · W + b.
+
+    Landmarks are trainable parameters initialized uniformly in the feature
+    cube [0,1]^n (use ``init_landmarks_from_data`` to seed them with real
+    samples). Input features: (B, n_qubits) in [0,1], same contract as the
+    angle-encoded VQC.
+    """
+
+    def init(key: jax.Array):
+        k_lm, k_w = jax.random.split(key)
+        landmarks = landmark_scale * jax.random.uniform(
+            k_lm, (n_landmarks, n_qubits), dtype=jnp.float32
+        )
+        w = 0.1 * jax.random.normal(
+            k_w, (n_landmarks, num_classes), dtype=jnp.float32
+        )
+        return {
+            "landmarks": landmarks,
+            "w": w,
+            "b": jnp.zeros((num_classes,), dtype=jnp.float32),
+        }
+
+    def apply(params, x):
+        k = kernel_matrix(x, params["landmarks"], basis)
+        return k @ params["w"] + params["b"]
+
+    return Model(
+        init=init,
+        apply=apply,
+        name=f"qkernel{n_qubits}q{n_landmarks}m",
+    )
+
+
+def init_landmarks_from_data(params: dict, x: jnp.ndarray) -> dict:
+    """Replace random landmarks with the first M training samples."""
+    m = params["landmarks"].shape[0]
+    if x.shape[0] < m:
+        raise ValueError(f"need ≥{m} samples to seed {m} landmarks")
+    return {**params, "landmarks": jnp.asarray(x[:m], dtype=jnp.float32)}

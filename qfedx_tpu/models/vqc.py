@@ -81,8 +81,29 @@ def make_vqc_classifier(
             return noise_model.noisy_logits(state, params["readout"], key)
         return z_logits(state, params["readout"])
 
+    # Finite-shot sampling needs a PRNG key, which the deterministic
+    # ``apply`` contract doesn't carry: evaluation uses the exact
+    # expectation (infinite-shot limit), training (``apply_train``) samples
+    # real shot noise from per-sample key streams.
+    eval_noise = (
+        noise_model.exact_shots() if noise_model is not None else None
+    )
+
     def apply(params, x):
-        return jax.vmap(lambda xi: apply_one(params, xi))(x)
+        def one(xi):
+            state = forward_state(params, xi)
+            if eval_noise is not None:
+                return eval_noise.noisy_logits(state, params["readout"], None)
+            return z_logits(state, params["readout"])
+
+        return jax.vmap(one)(x)
+
+    apply_train = None
+    if noise_model is not None and noise_model.shots is not None:
+
+        def apply_train(params, x, key):
+            keys = jax.random.split(key, x.shape[0])
+            return jax.vmap(lambda xi, k: apply_one(params, xi, k))(x, keys)
 
     def wrap_delta(delta):
         return {
@@ -97,5 +118,6 @@ def make_vqc_classifier(
         init=init,
         apply=apply,
         wrap_delta=wrap_delta,
+        apply_train=apply_train,
         name=f"vqc{n_qubits}q{n_layers}l-{encoding}",
     )

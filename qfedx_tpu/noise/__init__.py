@@ -1,0 +1,14 @@
+from qfedx_tpu.noise.channels import (  # noqa: F401
+    NoiseModel,
+    amplitude_damping_kraus,
+    apply_confusion_to_z,
+    bit_flip_kraus,
+    confusion_matrix,
+    depolarizing_kraus,
+    phase_flip_kraus,
+)
+from qfedx_tpu.noise.trajectory import (  # noqa: F401
+    apply_channel,
+    apply_channel_all,
+    trajectory_average,
+)

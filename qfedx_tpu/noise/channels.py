@@ -1,0 +1,143 @@
+"""Quantum noise channels — analytic ⟨Z⟩ maps and Kraus operators.
+
+Implements the reference's specified-but-unbuilt noise phase (reference
+ROADMAP.md:64-73): depolarizing(p), amplitude damping(γ), readout confusion,
+finite shots. Two levels of fidelity, both jit/vmap-safe:
+
+- **Analytic readout channels** (this module's ``NoiseModel``): for
+  single-qubit Z observables, product channels applied before measurement
+  have closed-form action on ⟨Z⟩ — depolarizing shrinks the Bloch vector
+  (⟨Z⟩→(1−p)⟨Z⟩), amplitude damping pulls toward |0⟩
+  (⟨Z⟩→⟨Z⟩+γ(1−⟨Z⟩)), a symmetric readout flip e gives (1−2e)⟨Z⟩, and
+  finite shots binomially sample P(0)=(1+⟨Z⟩)/2. Exact, deterministic
+  (except shots), and free — no extra state evolution.
+- **Trajectory sampling** (noise.trajectory): general Kraus channels
+  applied *inside* the circuit by stochastic unraveling, for noise that
+  doesn't commute to the readout (e.g. damping between entangling layers).
+
+The Kraus constructors here feed the trajectory engine; tests cross-check
+the analytic maps against trajectory averages.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from qfedx_tpu.ops.cpx import CArray, RDTYPE, from_complex
+from qfedx_tpu.ops.statevector import expect_z_all
+
+
+# --- Kraus operator sets (stacked (k, 2, 2) CArrays) -----------------------
+
+
+def depolarizing_kraus(p: float) -> CArray:
+    """{√(1−p)·I, √(p/3)·X, √(p/3)·Y, √(p/3)·Z}."""
+    s0, s1 = np.sqrt(1.0 - p), np.sqrt(p / 3.0)
+    ops = np.stack(
+        [
+            s0 * np.eye(2),
+            s1 * np.array([[0, 1], [1, 0]]),
+            s1 * np.array([[0, -1j], [1j, 0]]),
+            s1 * np.array([[1, 0], [0, -1]]),
+        ]
+    )
+    return from_complex(ops)
+
+
+def amplitude_damping_kraus(gamma: float) -> CArray:
+    """{[[1,0],[0,√(1−γ)]], [[0,√γ],[0,0]]}."""
+    k0 = np.array([[1.0, 0.0], [0.0, np.sqrt(1.0 - gamma)]])
+    k1 = np.array([[0.0, np.sqrt(gamma)], [0.0, 0.0]])
+    return CArray(jnp.asarray(np.stack([k0, k1]), dtype=RDTYPE), None)
+
+
+def bit_flip_kraus(p: float) -> CArray:
+    k0 = np.sqrt(1.0 - p) * np.eye(2)
+    k1 = np.sqrt(p) * np.array([[0.0, 1.0], [1.0, 0.0]])
+    return CArray(jnp.asarray(np.stack([k0, k1]), dtype=RDTYPE), None)
+
+
+def phase_flip_kraus(p: float) -> CArray:
+    k0 = np.sqrt(1.0 - p) * np.eye(2)
+    k1 = np.sqrt(p) * np.diag([1.0, -1.0])
+    return CArray(jnp.asarray(np.stack([k0, k1]), dtype=RDTYPE), None)
+
+
+# --- readout confusion -----------------------------------------------------
+
+
+def confusion_matrix(e01: float, e10: float) -> jnp.ndarray:
+    """Column-stochastic M[measured, true]: P(read i | prepared j).
+
+    e01 = P(read 1 | true 0), e10 = P(read 0 | true 1)
+    (reference ROADMAP.md:67's readout confusion matrices).
+    """
+    return jnp.asarray(
+        [[1.0 - e01, e10], [e01, 1.0 - e10]], dtype=RDTYPE
+    )
+
+
+def apply_confusion_to_z(z: jnp.ndarray, e01: float, e10: float) -> jnp.ndarray:
+    """⟨Z⟩ after pushing per-qubit marginals through the confusion matrix."""
+    p0 = (1.0 + z) / 2.0
+    p0_read = (1.0 - e01) * p0 + e10 * (1.0 - p0)
+    return 2.0 * p0_read - 1.0
+
+
+# --- the model-facing bundle ----------------------------------------------
+
+
+@dataclass(frozen=True)
+class NoiseModel:
+    """Readout-time noise bundle, pluggable into ``make_vqc_classifier``.
+
+    Channel order (physical: circuit noise, then measurement):
+    depolarizing → amplitude damping → readout confusion → finite shots.
+    ``shots=None`` means the exact expectation (infinite shots).
+    """
+
+    depolarizing_p: float = 0.0
+    amp_damping_gamma: float = 0.0
+    readout_e01: float = 0.0  # P(read 1 | true 0)
+    readout_e10: float = 0.0  # P(read 0 | true 1)
+    shots: int | None = None
+
+    def exact_shots(self) -> "NoiseModel":
+        """This model in the infinite-shot limit (for deterministic eval)."""
+        if self.shots is None:
+            return self
+        return NoiseModel(
+            depolarizing_p=self.depolarizing_p,
+            amp_damping_gamma=self.amp_damping_gamma,
+            readout_e01=self.readout_e01,
+            readout_e10=self.readout_e10,
+            shots=None,
+        )
+
+    def apply_to_z(self, z: jnp.ndarray, key: jax.Array | None) -> jnp.ndarray:
+        if self.depolarizing_p > 0.0:
+            z = (1.0 - self.depolarizing_p) * z
+        if self.amp_damping_gamma > 0.0:
+            z = z + self.amp_damping_gamma * (1.0 - z)
+        if self.readout_e01 > 0.0 or self.readout_e10 > 0.0:
+            z = apply_confusion_to_z(z, self.readout_e01, self.readout_e10)
+        if self.shots is not None:
+            if key is None:
+                raise ValueError("finite-shot noise needs a PRNG key")
+            p0 = jnp.clip((1.0 + z) / 2.0, 0.0, 1.0)
+            counts = jax.random.binomial(key, self.shots, p0)
+            z = 2.0 * counts / self.shots - 1.0
+        return z
+
+    def noisy_logits(
+        self, state: CArray, readout_params: dict, key: jax.Array | None
+    ) -> jnp.ndarray:
+        """Noisy version of circuits.readout.z_logits (same contract)."""
+        num_classes = readout_params["scale"].shape[0]
+        z = expect_z_all(state)[:num_classes]
+        z = self.apply_to_z(z, key)
+        return readout_params["scale"] * z + readout_params["bias"]

@@ -1,0 +1,78 @@
+"""Stochastic trajectory (quantum-jump) simulation of Kraus channels.
+
+General circuit-level noise on a pure-state simulator (reference
+ROADMAP.md:64-73 asks for depolarizing/damping channels; a statevector
+engine can't hold a density matrix, so mixed states are simulated as an
+average over pure trajectories — the standard unraveling, O(2^n) per
+trajectory instead of O(4^n) for the exact density matrix):
+
+    ψ → K_i ψ / ‖K_i ψ‖  with probability ‖K_i ψ‖²
+
+Everything is traced: the Kraus branch is *sampled* with
+``jax.random.categorical`` and *selected* with ``jnp.take`` over the
+stacked candidate states — no data-dependent Python control flow, so
+trajectories jit, vmap over keys, and differentiate (the estimator is the
+score-free reparameterized average; gradients flow through the selected
+branch).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from qfedx_tpu.ops.cpx import CArray
+from qfedx_tpu.ops import statevector as sv
+
+
+def _kraus_op(kraus: CArray, i: int) -> CArray:
+    return CArray(kraus.re[i], None if kraus.im is None else kraus.im[i])
+
+
+def apply_channel(
+    state: CArray, kraus: CArray, qubit: int, key: jax.Array
+) -> CArray:
+    """One sampled Kraus branch of a single-qubit channel on ``qubit``.
+
+    ``kraus``: stacked (k, 2, 2) CArray. Applies every branch (k ≤ 4 small
+    matmuls), samples by Born weights, selects, renormalizes.
+    """
+    n_k = kraus.re.shape[0]
+    outs = [sv.apply_gate(state, _kraus_op(kraus, i), qubit) for i in range(n_k)]
+    probs = jnp.stack([jnp.sum(sv.cabs2(o)) for o in outs])
+    idx = jax.random.categorical(key, jnp.log(jnp.maximum(probs, 1e-30)))
+
+    any_im = any(o.im is not None for o in outs)
+    re = jnp.take(jnp.stack([o.re for o in outs]), idx, axis=0)
+    im = (
+        jnp.take(jnp.stack([o.imag_or_zeros() for o in outs]), idx, axis=0)
+        if any_im
+        else None
+    )
+    norm = jnp.sqrt(jnp.maximum(jnp.take(probs, idx), 1e-30))
+    return CArray(re / norm, None if im is None else im / norm)
+
+
+def apply_channel_all(state: CArray, kraus: CArray, key: jax.Array) -> CArray:
+    """The channel independently on every qubit (one key split per qubit)."""
+    keys = jax.random.split(key, state.ndim)
+    for q in range(state.ndim):
+        state = apply_channel(state, kraus, q, keys[q])
+    return state
+
+
+def trajectory_average(observable_fn, n_trajectories: int):
+    """Monte-Carlo channel average: E over trajectories of an observable.
+
+    ``observable_fn(key) -> array`` runs one noisy trajectory (building its
+    circuit with ``apply_channel`` calls keyed off ``key``). Returns a
+    function ``(key) -> array`` that vmaps ``n_trajectories`` keys and
+    averages — the density-matrix expectation, to O(1/√T) sampling error.
+    """
+
+    def averaged(key: jax.Array):
+        keys = jax.random.split(key, n_trajectories)
+        vals = jax.vmap(observable_fn)(keys)
+        return jnp.mean(vals, axis=0)
+
+    return averaged

@@ -83,7 +83,18 @@ def _apply(gate: CArray, state: CArray, axes, src, dst) -> CArray:
 
 
 def apply_gate(state: CArray, gate: CArray, qubit: int) -> CArray:
-    """Apply a (2,2) gate to axis ``qubit`` of a (2,)*n state."""
+    """Apply a (2,2) gate to axis ``qubit`` of a (2,)*n state.
+
+    With QFEDX_PALLAS=1 on TPU, large complex states (≥2^14 amplitudes)
+    stream through the fused Pallas kernel (ops.pallas_gates) instead;
+    known-real cases keep the trace-time cross-term elision below, which
+    the general kernel can't match.
+    """
+    if state.ndim >= 14 and state.im is not None and gate.ndim == 2:
+        from qfedx_tpu.ops import pallas_gates
+
+        if pallas_gates.pallas_enabled():
+            return pallas_gates.apply_gate_pallas(state, gate, qubit)
     return _apply(gate, state, ((1,), (qubit,)), 0, qubit)
 
 

@@ -1,0 +1,16 @@
+from qfedx_tpu.parallel.sharded import (  # noqa: F401
+    ShardCtx,
+    apply_gate_2q_sharded,
+    apply_gate_sharded,
+    expect_z_all_sharded,
+    expect_z_sharded,
+    from_dense,
+    norm_sq_sharded,
+    product_state_local,
+    swap_global_local,
+    zero_state_local,
+)
+from qfedx_tpu.parallel.circuit import (  # noqa: F401
+    make_sharded_forward,
+    sharded_hea_state,
+)

@@ -1,0 +1,77 @@
+"""Sharded VQC forward: the full circuit as one shard_map program.
+
+Composes the sharded engine (parallel.sharded) into the same
+encoder → hardware-efficient-ansatz → ⟨Z⟩ pipeline the dense path runs
+(circuits.ansatz / models.vqc), but with the statevector distributed over a
+mesh axis — the path to the reference roadmap's ≥20-qubit regime
+(reference ROADMAP.md:86,105). The circuit structure is identical; only the
+gate-application primitives change, which is the point: scaling out is an
+engine swap, not a model rewrite.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from qfedx_tpu.circuits.encoders import angle_amplitudes
+from qfedx_tpu.ops import gates
+from qfedx_tpu.parallel.sharded import (
+    ShardCtx,
+    apply_gate_2q_sharded,
+    apply_gate_sharded,
+    expect_z_all_sharded,
+    product_state_local,
+)
+
+
+def sharded_hea_state(ctx: ShardCtx, features: jnp.ndarray, params: dict):
+    """Angle-encode ``features`` (shape (n,), in [0,1]) and run the
+    hardware-efficient ansatz, all on the sharded state. Mirrors
+    circuits.ansatz.hardware_efficient gate-for-gate."""
+    n = ctx.n_qubits
+    state = product_state_local(ctx, angle_amplitudes(features * jnp.pi, "ry"))
+    n_layers = params["rx"].shape[0]
+    for layer in range(n_layers):
+        for q in range(n):
+            state = apply_gate_sharded(ctx, state, gates.rx(params["rx"][layer, q]), q)
+            state = apply_gate_sharded(ctx, state, gates.rz(params["rz"][layer, q]), q)
+        if n >= 2:
+            for q in range(n - 1):
+                state = apply_gate_2q_sharded(ctx, state, gates.CNOT, q, q + 1)
+            if n > 2:
+                state = apply_gate_2q_sharded(ctx, state, gates.CNOT, n - 1, 0)
+    return state
+
+
+def make_sharded_forward(
+    n_qubits: int, mesh: Mesh, axis: str = "sv"
+):
+    """Build jitted ``forward(params, x) -> ⟨Z⟩ per qubit``.
+
+    ``x``: one sample, shape (n_qubits,). The state axis is ``axis`` of
+    ``mesh`` (size must be a power of two ≤ 2^(n_qubits-2) so 2q gates have
+    scratch local qubits). Batch with an outer vmap-of-jit or lax.map on the
+    host side; each sample's state already occupies the whole mesh.
+    """
+    size = mesh.shape[axis]
+    n_global = (size - 1).bit_length()
+    if 1 << n_global != size:
+        raise ValueError(f"mesh axis {axis} size {size} is not a power of two")
+    if n_qubits - n_global < 2:
+        raise ValueError("need ≥2 local qubits (mesh too large for qubit count)")
+    ctx = ShardCtx(axis=axis, n_qubits=n_qubits, n_global=n_global)
+
+    def per_device(params, x):
+        state = sharded_hea_state(ctx, x, params)
+        return expect_z_all_sharded(ctx, state)
+
+    sharded = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded), ctx

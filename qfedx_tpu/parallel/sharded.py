@@ -1,0 +1,283 @@
+"""Device-sharded statevector engine — the framework's sequence parallelism.
+
+The reference caps dense statevector simulation at ~20 qubits on one device
+and points beyond to distributed simulation (reference ROADMAP.md:86); its
+actual backend is a single-process Qiskit dense statevector (reference
+src/QFed/qAmplitude.py:44-46). Here the 2^n-amplitude state is sharded
+across a ``jax.sharding.Mesh`` axis of D = 2^d devices: qubits 0..d-1 are
+*global* (their bits select the device), qubits d..n-1 are *local* (axes of
+the per-device shard). This is SURVEY.md §5's long-context analog — the
+role ring attention / sequence parallelism plays in an LLM framework, the
+sharded statevector plays here, with the same ingredients: a mesh axis,
+per-device blocks, and ICI collectives (``ppermute`` pair exchanges, one
+hop per global-qubit gate; ``psum`` for observables).
+
+All functions here run INSIDE ``shard_map`` over the state axis and take a
+``ShardCtx``. Memory per device: 2·4·2^(n-d) bytes, so 8 devices extend the
+single-chip qubit ceiling by 3 (e.g. 20-qubit dense → 23-qubit sharded on
+the same HBM).
+
+Device-bit convention: device index i = Σ_q bit_q << (d-1-q) — qubit 0 is
+the most-significant device bit, matching axis-0-major flattening of the
+dense (2,)*n tensor, so dense↔sharded round-trips are pure reshapes.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from qfedx_tpu.ops.cpx import CArray, RDTYPE, cabs2
+from qfedx_tpu.ops import statevector as sv
+
+
+class ShardCtx(NamedTuple):
+    """Static sharding geometry (Python ints — fixed at trace time)."""
+
+    axis: str  # mesh axis name the state is sharded over
+    n_qubits: int  # total qubits n
+    n_global: int  # d = log2(mesh axis size); qubits [0, d) are global
+
+    @property
+    def n_local(self) -> int:
+        return self.n_qubits - self.n_global
+
+    @property
+    def n_devices(self) -> int:
+        return 1 << self.n_global
+
+    def local_axis(self, qubit: int) -> int:
+        """Axis of ``qubit`` in the local shard (qubit must be local)."""
+        return qubit - self.n_global
+
+    def device_mask(self, qubit: int) -> int:
+        """Bitmask selecting ``qubit``'s bit in the device index."""
+        return 1 << (self.n_global - 1 - qubit)
+
+    def device_bit(self, qubit: int) -> jnp.ndarray:
+        """This device's value of global ``qubit`` (traced 0/1 scalar)."""
+        idx = jax.lax.axis_index(self.axis)
+        return (idx >> (self.n_global - 1 - qubit)) & 1
+
+
+def _pair_perm(ctx: ShardCtx, mask: int) -> list[tuple[int, int]]:
+    """ppermute permutation exchanging each device with its ``mask`` partner."""
+    return [(j, j ^ mask) for j in range(ctx.n_devices)]
+
+
+def _ppermute(ctx: ShardCtx, x: jnp.ndarray, mask: int) -> jnp.ndarray:
+    return jax.lax.ppermute(x, ctx.axis, perm=_pair_perm(ctx, mask))
+
+
+def _exchange(ctx: ShardCtx, c: CArray, mask: int) -> CArray:
+    """Partner's full shard (re and, if present, im) via one pair ppermute."""
+    re = _ppermute(ctx, c.re, mask)
+    im = None if c.im is None else _ppermute(ctx, c.im, mask)
+    return CArray(re, im)
+
+
+# --- state constructors ----------------------------------------------------
+
+
+def zero_state_local(ctx: ShardCtx) -> CArray:
+    """Local shard of |0…0⟩: amplitude 1 lives on device 0."""
+    shape = (2,) * ctx.n_local
+    one_hot = jnp.zeros((1 << ctx.n_local,), dtype=RDTYPE).at[0].set(1.0)
+    is_dev0 = (jax.lax.axis_index(ctx.axis) == 0).astype(RDTYPE)
+    return CArray((one_hot * is_dev0).reshape(shape), None)
+
+
+def product_state_local(ctx: ShardCtx, amps: CArray) -> CArray:
+    """Local shard of ⊗_q (amps[q,0]|0⟩ + amps[q,1]|1⟩); amps shape (n, 2).
+
+    Local qubits tensor-product exactly as in the dense engine; each global
+    qubit contributes the scalar amps[q, bit_q(device)]. This is how the
+    angle encoder reaches sharded widths with zero communication.
+    """
+    local = sv.product_state(
+        CArray(
+            amps.re[ctx.n_global :],
+            None if amps.im is None else amps.im[ctx.n_global :],
+        )
+    )
+    scale_re = jnp.asarray(1.0, dtype=RDTYPE)
+    scale_im = None
+    for q in range(ctx.n_global):
+        b = ctx.device_bit(q)
+        a_re = jnp.take(amps.re[q], b)
+        a_im = None if amps.im is None else jnp.take(amps.im[q], b)
+        if a_im is None:
+            scale_re = scale_re * a_re
+            scale_im = None if scale_im is None else scale_im * a_re
+        elif scale_im is None:
+            scale_re, scale_im = scale_re * a_re, scale_re * a_im
+        else:
+            scale_re, scale_im = (
+                scale_re * a_re - scale_im * a_im,
+                scale_re * a_im + scale_im * a_re,
+            )
+    if scale_im is None:
+        return CArray(local.re * scale_re, None if local.im is None else local.im * scale_re)
+    l_im = local.imag_or_zeros()
+    return CArray(
+        local.re * scale_re - l_im * scale_im,
+        local.re * scale_im + l_im * scale_re,
+    )
+
+
+def from_dense(ctx: ShardCtx, state: CArray) -> CArray:
+    """Dense (2,)*n CArray → this device's local shard (test convenience)."""
+    idx = jax.lax.axis_index(ctx.axis)
+    flat_re = state.re.reshape((ctx.n_devices,) + (2,) * ctx.n_local)
+    re = jnp.take(flat_re, idx, axis=0)
+    if state.im is None:
+        return CArray(re, None)
+    flat_im = state.im.reshape((ctx.n_devices,) + (2,) * ctx.n_local)
+    return CArray(re, jnp.take(flat_im, idx, axis=0))
+
+
+# --- gate application ------------------------------------------------------
+
+
+def _gate_elem(gate: CArray, r, c) -> CArray:
+    """gate[r, c] with traced 0/1 indices → scalar CArray."""
+    re = jnp.take(jnp.take(gate.re, r, axis=0), c, axis=0)
+    im = (
+        None
+        if gate.im is None
+        else jnp.take(jnp.take(gate.im, r, axis=0), c, axis=0)
+    )
+    return CArray(re, im)
+
+
+def _scale_add(a: CArray, sa: CArray, b: CArray, sb: CArray) -> CArray:
+    """sa·a + sb·b for tensors a,b and scalar CArrays sa,sb."""
+
+    def mul(t: CArray, s: CArray) -> CArray:
+        t_im = t.im
+        if s.im is None:
+            return CArray(t.re * s.re, None if t_im is None else t_im * s.re)
+        ti = t.imag_or_zeros()
+        return CArray(t.re * s.re - ti * s.im, t.re * s.im + ti * s.re)
+
+    x, y = mul(a, sa), mul(b, sb)
+    if x.im is None and y.im is None:
+        return CArray(x.re + y.re, None)
+    return CArray(x.re + y.re, x.imag_or_zeros() + y.imag_or_zeros())
+
+
+def apply_gate_sharded(
+    ctx: ShardCtx, state: CArray, gate: CArray, qubit: int
+) -> CArray:
+    """Apply a (2,2) gate to any qubit of the sharded state.
+
+    Local qubit: plain tensordot, zero communication. Global qubit: one
+    ppermute pair exchange — this device holds the bit=b half of the
+    amplitude pairs, its partner the bit=1−b half, so
+    out = gate[b,b]·mine + gate[b,1−b]·theirs.
+    """
+    if qubit >= ctx.n_global:
+        return sv.apply_gate(state, gate, ctx.local_axis(qubit))
+    b = ctx.device_bit(qubit)
+    theirs = _exchange(ctx, state, ctx.device_mask(qubit))
+    return _scale_add(state, _gate_elem(gate, b, b), theirs, _gate_elem(gate, b, 1 - b))
+
+
+def swap_global_local(ctx: ShardCtx, state: CArray, g: int, l: int) -> CArray:
+    """SWAP gate between global qubit ``g`` and local qubit ``l``.
+
+    The relabeling primitive (what an all-to-all axis swap is to sequence
+    parallelism): each device keeps the local slice whose l-bit equals its
+    g-bit and exchanges the other half with its partner. One ppermute of
+    half a shard.
+    """
+    assert g < ctx.n_global <= l < ctx.n_qubits
+    ax = ctx.local_axis(l)
+    b = ctx.device_bit(g)
+    mask = ctx.device_mask(g)
+
+    def swap_real(x: jnp.ndarray) -> jnp.ndarray:
+        keep = jnp.take(x, b, axis=ax)  # slice l = b: stays in place
+        send = jnp.take(x, 1 - b, axis=ax)  # slice l = 1−b: to partner
+        recv = _ppermute(ctx, send, mask)
+        # Rebuild with index b ← keep, index 1−b ← recv along axis ax.
+        pair = jnp.stack([keep, recv], axis=ax)  # [keep@0, recv@1]
+        flipped = jnp.stack([recv, keep], axis=ax)
+        return jnp.where(b == 0, pair, flipped)
+
+    re = swap_real(state.re)
+    im = None if state.im is None else swap_real(state.im)
+    return CArray(re, im)
+
+
+def apply_gate_2q_sharded(
+    ctx: ShardCtx, state: CArray, gate: CArray, q1: int, q2: int
+) -> CArray:
+    """Apply a (2,2,2,2) gate to any qubit pair of the sharded state.
+
+    Both local → plain tensordot. Global qubits are first swapped into
+    scratch local positions (2 ppermutes round-trip each), the gate applied
+    locally, then swapped back — the generic choreography that keeps every
+    gate shape supported at any width.
+    """
+    assert q1 != q2
+    globals_ = [q for q in (q1, q2) if q < ctx.n_global]
+    if not globals_:
+        return sv.apply_gate_2q(
+            state, gate, ctx.local_axis(q1), ctx.local_axis(q2)
+        )
+    if ctx.n_local < 2:
+        raise ValueError("need ≥2 local qubits for sharded 2q gates")
+    # Scratch local qubits not otherwise involved in the gate.
+    in_use = {q1, q2}
+    scratch = [q for q in range(ctx.n_global, ctx.n_qubits) if q not in in_use]
+    mapping = {}  # global qubit → borrowed local position
+    for g in globals_:
+        mapping[g] = scratch.pop()
+        state = swap_global_local(ctx, state, g, mapping[g])
+    a1, a2 = mapping.get(q1, q1), mapping.get(q2, q2)
+    state = sv.apply_gate_2q(state, gate, ctx.local_axis(a1), ctx.local_axis(a2))
+    for g, l in reversed(list(mapping.items())):
+        state = swap_global_local(ctx, state, g, l)
+    return state
+
+
+# --- observables -----------------------------------------------------------
+
+
+def expect_z_sharded(ctx: ShardCtx, state: CArray, qubit: int) -> jnp.ndarray:
+    """⟨Z_qubit⟩, identical on every device after one psum."""
+    probs = cabs2(state)
+    if qubit >= ctx.n_global:
+        ax = ctx.local_axis(qubit)
+        n = probs.ndim
+        z = jnp.array([1.0, -1.0], dtype=probs.dtype).reshape(
+            (1,) * ax + (2,) + (1,) * (n - ax - 1)
+        )
+        local = jnp.sum(probs * z)
+    else:
+        sign = 1.0 - 2.0 * ctx.device_bit(qubit).astype(probs.dtype)
+        local = sign * jnp.sum(probs)
+    return jax.lax.psum(local, ctx.axis)
+
+
+def expect_z_all_sharded(ctx: ShardCtx, state: CArray) -> jnp.ndarray:
+    """⟨Z_k⟩ for all k, shape (n,), one fused psum for all qubits."""
+    probs = cabs2(state)
+    locals_ = []
+    for q in range(ctx.n_qubits):
+        if q >= ctx.n_global:
+            ax = ctx.local_axis(q)
+            marg = jnp.sum(probs, axis=tuple(i for i in range(probs.ndim) if i != ax))
+            locals_.append(marg[0] - marg[1])
+        else:
+            sign = 1.0 - 2.0 * ctx.device_bit(q).astype(probs.dtype)
+            locals_.append(sign * jnp.sum(probs))
+    return jax.lax.psum(jnp.stack(locals_), ctx.axis)
+
+
+def norm_sq_sharded(ctx: ShardCtx, state: CArray) -> jnp.ndarray:
+    """‖ψ‖² (should be 1) — correctness probe across all shards."""
+    return jax.lax.psum(jnp.sum(cabs2(state)), ctx.axis)

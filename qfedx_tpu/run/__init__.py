@@ -1,1 +1,10 @@
 from qfedx_tpu.run.trainer import TrainResult, train_federated  # noqa: F401
+from qfedx_tpu.run.checkpoint import Checkpointer  # noqa: F401
+from qfedx_tpu.run.config import (  # noqa: F401
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    build_data,
+    build_model,
+)
+from qfedx_tpu.run.metrics import ExperimentRun, MetricsLogger  # noqa: F401

@@ -1,0 +1,118 @@
+"""Round-K checkpointing with resume.
+
+The reference never persists model weights (reference SURVEY.md §5:
+the only `torch.save` is for preprocessed data, src/CFed/Preprocess.py:192-199);
+its roadmap specifies checkpoint-θ-every-K-rounds with dropout-tolerant
+resume (reference ROADMAP.md:90-91). Here a checkpoint is a single
+`.npz` of the flattened parameter pytree plus a JSON sidecar with the
+treedef and round number — dependency-light, atomic (write-to-temp +
+rename), and restorable on any host/device topology since params are
+replicated in SPMD.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _flatten(params: Any):
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    return leaves, treedef
+
+
+class Checkpointer:
+    """Save params every ``every`` rounds to ``dir``; keep last ``keep``.
+
+    Files: ``ckpt_{round:06d}.npz`` (leaves as arr_0..arr_N) +
+    ``ckpt_{round:06d}.json`` ({"round": r, "n_leaves": N}).
+    Restore validates leaf count/shapes against a template pytree, so a
+    checkpoint from a different model config fails loudly, not silently.
+    """
+
+    _PAT = re.compile(r"ckpt_(\d{6})\.npz$")
+
+    def __init__(self, directory: str | os.PathLike, every: int = 5, keep: int = 3):
+        if every < 1:
+            raise ValueError("every must be ≥ 1")
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.every = every
+        self.keep = keep
+
+    # -- save ----------------------------------------------------------------
+
+    def save(self, round_idx: int, params: Any) -> Path:
+        leaves, _ = _flatten(params)
+        host_leaves = [np.asarray(x) for x in leaves]
+        path = self.dir / f"ckpt_{round_idx:06d}.npz"
+        tmp = path.with_suffix(".npz.tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, *host_leaves)
+        os.replace(tmp, path)
+        meta = {"round": round_idx, "n_leaves": len(host_leaves)}
+        meta_path = path.with_suffix(".json")
+        tmp_meta = meta_path.with_suffix(".json.tmp")
+        tmp_meta.write_text(json.dumps(meta))
+        os.replace(tmp_meta, meta_path)
+        self._gc()
+        return path
+
+    def maybe_save(self, round_idx: int, params: Any) -> Path | None:
+        if round_idx % self.every == 0:
+            return self.save(round_idx, params)
+        return None
+
+    def _gc(self) -> None:
+        if self.keep <= 0:
+            return
+        rounds = sorted(self._rounds())
+        for r in rounds[: -self.keep]:
+            (self.dir / f"ckpt_{r:06d}.npz").unlink(missing_ok=True)
+            (self.dir / f"ckpt_{r:06d}.json").unlink(missing_ok=True)
+
+    # -- restore -------------------------------------------------------------
+
+    def _rounds(self) -> list[int]:
+        out = []
+        for p in self.dir.iterdir():
+            m = self._PAT.search(p.name)
+            if m:
+                out.append(int(m.group(1)))
+        return out
+
+    def latest_round(self) -> int | None:
+        rounds = self._rounds()
+        return max(rounds) if rounds else None
+
+    def restore(self, round_idx: int, template: Any) -> Any:
+        """Load round ``round_idx`` into the structure of ``template``."""
+        path = self.dir / f"ckpt_{round_idx:06d}.npz"
+        leaves, treedef = _flatten(template)
+        with np.load(path) as data:
+            loaded = [data[f"arr_{i}"] for i in range(len(data.files))]
+        if len(loaded) != len(leaves):
+            raise ValueError(
+                f"checkpoint has {len(loaded)} leaves, template has {len(leaves)}"
+            )
+        for i, (got, want) in enumerate(zip(loaded, leaves)):
+            if got.shape != np.shape(want):
+                raise ValueError(
+                    f"leaf {i}: checkpoint shape {got.shape} != model {np.shape(want)}"
+                )
+        return jax.tree_util.tree_unflatten(
+            treedef, [jax.numpy.asarray(x) for x in loaded]
+        )
+
+    def restore_latest(self, template: Any) -> tuple[Any, int] | None:
+        """(params, round) of the newest checkpoint, or None if empty."""
+        r = self.latest_round()
+        if r is None:
+            return None
+        return self.restore(r, template), r

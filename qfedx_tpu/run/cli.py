@@ -1,0 +1,196 @@
+"""Command-line entry point: ``python -m qfedx_tpu train ...``.
+
+The reference has no CLI — its three entry points are scripts with
+hard-coded dicts (reference SURVEY.md §3.4); this replaces them with one
+argparse-driven command that assembles an ExperimentConfig, runs the SPMD
+federated trainer, tracks the run (config/metrics/checkpoints/summary in a
+run directory), and prints the metric table the reference's roadmap calls
+for (accuracy, ε, wall-clock, MB/round — reference ROADMAP.md:111-116).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from qfedx_tpu.fed.config import DPConfig, FedConfig
+from qfedx_tpu.run.config import (
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    build_data,
+    build_model,
+)
+
+
+def _parse_classes(s: str | None):
+    if s is None or s == "all":
+        return None
+    return tuple(int(c) for c in s.split(","))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="qfedx_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="run federated training")
+    # data
+    t.add_argument("--dataset", default="mnist",
+                   choices=["mnist", "fashion_mnist", "cifar10"])
+    t.add_argument("--raw-folder", default=None,
+                   help="folder with IDX/CIFAR files; synthetic fallback if absent")
+    t.add_argument("--classes", default="0,1,2",
+                   help="comma-separated class subset, or 'all'")
+    t.add_argument("--features", default="pca",
+                   choices=["image", "downsample", "pool", "pca"])
+    t.add_argument("--clients", type=int, default=4)
+    t.add_argument("--partition", default="iid", choices=["iid", "dirichlet"])
+    t.add_argument("--alpha", type=float, default=0.5)
+    # model
+    t.add_argument("--model", default="vqc", choices=["vqc", "cnn", "qkernel"])
+    t.add_argument("--qubits", type=int, default=8)
+    t.add_argument("--layers", type=int, default=2)
+    t.add_argument("--encoding", default="angle",
+                   choices=["angle", "amplitude", "reupload"])
+    t.add_argument("--landmarks", type=int, default=16)
+    t.add_argument("--depolarizing", type=float, default=0.0)
+    t.add_argument("--damping", type=float, default=0.0)
+    t.add_argument("--readout-flip", type=float, default=0.0)
+    t.add_argument("--shots", type=int, default=None)
+    # federated
+    t.add_argument("--rounds", type=int, default=30)
+    t.add_argument("--local-epochs", type=int, default=5)
+    t.add_argument("--batch-size", type=int, default=32)
+    t.add_argument("--lr", type=float, default=0.01)
+    t.add_argument("--optimizer", default="sgd", choices=["sgd", "adam", "spsa"])
+    t.add_argument("--algorithm", default="fedavg", choices=["fedavg", "fedprox"])
+    t.add_argument("--prox-mu", type=float, default=0.01)
+    t.add_argument("--client-fraction", type=float, default=1.0)
+    t.add_argument("--dp-clip", type=float, default=None,
+                   help="enable DP with this L2 clip norm")
+    t.add_argument("--dp-sigma", type=float, default=1.0)
+    t.add_argument("--secure-agg", action="store_true")
+    # run
+    t.add_argument("--eval-every", type=int, default=1)
+    t.add_argument("--checkpoint-every", type=int, default=5)
+    t.add_argument("--seed", type=int, default=42)
+    t.add_argument("--run-root", default="runs")
+    t.add_argument("--name", default=None)
+    t.add_argument("--resume", action="store_true",
+                   help="reuse the --name run dir and resume from its latest checkpoint")
+    return p
+
+
+def config_from_args(a: argparse.Namespace) -> ExperimentConfig:
+    dp = (
+        DPConfig(clip_norm=a.dp_clip, noise_multiplier=a.dp_sigma)
+        if a.dp_clip is not None
+        else None
+    )
+    return ExperimentConfig(
+        data=DataConfig(
+            dataset=a.dataset,
+            raw_folder=a.raw_folder,
+            classes=_parse_classes(a.classes),
+            features=a.features,
+            num_clients=a.clients,
+            partition=a.partition,
+            alpha=a.alpha,
+            seed=a.seed,
+        ),
+        model=ModelConfig(
+            model=a.model,
+            n_qubits=a.qubits,
+            n_layers=a.layers,
+            encoding=a.encoding,
+            n_landmarks=a.landmarks,
+            depolarizing_p=a.depolarizing,
+            amp_damping_gamma=a.damping,
+            readout_flip=a.readout_flip,
+            shots=a.shots,
+        ),
+        fed=FedConfig(
+            local_epochs=a.local_epochs,
+            batch_size=a.batch_size,
+            learning_rate=a.lr,
+            optimizer=a.optimizer,
+            algorithm=a.algorithm,
+            prox_mu=a.prox_mu if a.algorithm == "fedprox" else 0.0,
+            client_fraction=a.client_fraction,
+            dp=dp,
+            secure_agg=a.secure_agg,
+        ),
+        num_rounds=a.rounds,
+        eval_every=a.eval_every,
+        checkpoint_every=a.checkpoint_every,
+        seed=a.seed,
+        run_root=a.run_root,
+        name=a.name,
+    )
+
+
+def run_train(cfg: ExperimentConfig, resume: bool = False) -> dict:
+    from qfedx_tpu.fed.evaluate import make_evaluator
+    from qfedx_tpu.run.metrics import ExperimentRun
+    from qfedx_tpu.run.trainer import train_federated
+
+    data = build_data(cfg)
+    model = build_model(cfg, data["num_classes"])
+    test_x, test_y = data["test"]
+    val_x, val_y = data["val"]
+    # Per-round eval on the held-out validation split (what it's carved out
+    # for); the test set is touched once, at the end.
+    have_val = len(val_y) > 0
+    eval_x, eval_y = (val_x, val_y) if have_val else (test_x, test_y)
+
+    with ExperimentRun(cfg.run_root, cfg.run_name(), config=cfg, resume=resume) as run:
+        print(f"[qfedx_tpu] run dir: {run.dir}")
+        print(
+            f"[qfedx_tpu] model={model.name} clients={data['cx'].shape[0]} "
+            f"samples/client≤{data['cx'].shape[1]} classes={data['num_classes']}"
+        )
+        result = train_federated(
+            model,
+            cfg.fed,
+            data["cx"],
+            data["cy"],
+            data["cmask"],
+            eval_x,
+            eval_y,
+            num_rounds=cfg.num_rounds,
+            seed=cfg.seed,
+            eval_every=cfg.eval_every,
+            on_round_end=lambda r, m: (
+                run.on_round_end(r, m),
+                print(f"[round {r + 1:3d}] " + json.dumps(m)) if (r + 1) % 5 == 0 else None,
+            )[0],
+            checkpointer=run.checkpointer(every=cfg.checkpoint_every),
+        )
+        test_metrics = make_evaluator(model)(result.params, test_x, test_y)
+        summary = {
+            "final_accuracy": test_metrics["accuracy"],
+            "final_val_accuracy": result.final_accuracy if have_val else None,
+            "final_auc": test_metrics.get("auc"),
+            "rounds": cfg.num_rounds,
+            "mean_round_time_s": (
+                sum(result.round_times_s) / len(result.round_times_s)
+                if result.round_times_s
+                else 0.0
+            ),
+            "comm_mb_per_round": result.comm_mb_per_round,
+            "final_epsilon": result.epsilons[-1] if result.epsilons else None,
+        }
+        run.finish(**summary)
+        print("[qfedx_tpu] " + json.dumps(summary))
+        return summary
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.cmd == "train":
+        cfg = config_from_args(args)
+        run_train(cfg, resume=args.resume)
+
+
+if __name__ == "__main__":
+    main()

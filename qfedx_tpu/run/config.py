@@ -1,0 +1,168 @@
+"""Experiment configuration: one typed schema for the full stack.
+
+Replaces the reference's hard-coded config dicts triplicated across its
+three entry points (reference src/CFed/Classical_FL.py:161-173,
+src/QFed/testEncoder.py:64-72, src/CFed/Preprocess.py:239-247) and stands
+in for the Hydra system its roadmap specifies (reference ROADMAP.md:16,70).
+A single ``ExperimentConfig`` builds the dataset, the partition, the model,
+and the federated config — so every run is reproducible from one JSON blob
+(written to the run directory by run.metrics.ExperimentRun).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from qfedx_tpu.fed.config import DPConfig, FedConfig
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    dataset: str = "mnist"  # mnist | fashion_mnist | cifar10
+    raw_folder: str | None = None  # IDX/pickle files; synthetic fallback if absent
+    classes: tuple[int, ...] | None = (0, 1, 2)  # reference default digit subset
+    features: str = "pca"  # image | downsample | pool | pca
+    n_features: int | None = None  # defaults to n_qubits for quantum models
+    val_split: float = 0.1
+    num_clients: int = 4
+    partition: str = "iid"  # iid | dirichlet
+    alpha: float = 0.5  # Dirichlet concentration (ROADMAP.md:106)
+    seed: int = 42
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    model: str = "vqc"  # vqc | cnn | qkernel
+    n_qubits: int = 8
+    n_layers: int = 2
+    encoding: str = "angle"  # angle | amplitude | reupload
+    n_landmarks: int = 16  # qkernel only
+    # noise (ROADMAP.md:64-73); zeros = noiseless
+    depolarizing_p: float = 0.0
+    amp_damping_gamma: float = 0.0
+    readout_flip: float = 0.0
+    shots: int | None = None
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    fed: FedConfig = field(default_factory=FedConfig)
+    num_rounds: int = 30  # reference Classical_FL.py:168
+    eval_every: int = 1
+    checkpoint_every: int = 5
+    seed: int = 42
+    run_root: str = "runs"
+    name: str | None = None
+
+    def run_name(self) -> str:
+        if self.name:
+            return self.name
+        m = self.model
+        tag = (
+            f"{m.model}{m.n_qubits}q" if m.model != "cnn" else "cnn"
+        )
+        return f"{tag}-{self.data.dataset}-c{self.data.num_clients}-{self.fed.algorithm}"
+
+
+def build_model(cfg: ExperimentConfig, num_classes: int):
+    """ModelConfig → Model (with noise bundle when any noise is on)."""
+    m = cfg.model
+    if m.model == "cnn":
+        from qfedx_tpu.models.cnn import make_tiny_cnn
+        from qfedx_tpu.data.datasets import SPECS
+
+        spec = SPECS[cfg.data.dataset]
+        return make_tiny_cnn(
+            num_classes=num_classes,
+            height=spec.height,
+            width=spec.width,
+            in_channels=spec.channels,
+        )
+    if m.model == "qkernel":
+        from qfedx_tpu.models.kernel import make_quantum_kernel_classifier
+
+        return make_quantum_kernel_classifier(
+            m.n_qubits, n_landmarks=m.n_landmarks, num_classes=num_classes
+        )
+    if m.model == "vqc":
+        from qfedx_tpu.models.vqc import make_vqc_classifier
+
+        noise_model = None
+        if m.depolarizing_p or m.amp_damping_gamma or m.readout_flip or m.shots:
+            from qfedx_tpu.noise.channels import NoiseModel
+
+            noise_model = NoiseModel(
+                depolarizing_p=m.depolarizing_p,
+                amp_damping_gamma=m.amp_damping_gamma,
+                readout_e01=m.readout_flip,
+                readout_e10=m.readout_flip,
+                shots=m.shots,
+            )
+        return make_vqc_classifier(
+            n_qubits=m.n_qubits,
+            n_layers=m.n_layers,
+            num_classes=num_classes,
+            encoding=m.encoding,
+            noise_model=noise_model,
+        )
+    raise ValueError(f"unknown model {m.model!r}")
+
+
+def build_data(cfg: ExperimentConfig) -> dict[str, Any]:
+    """DataConfig → packed client arrays + test set + metadata."""
+    from qfedx_tpu.data.datasets import load_dataset
+    from qfedx_tpu.data.partition import (
+        dirichlet_partition,
+        iid_partition,
+        pack_clients,
+        partition_stats,
+    )
+    from qfedx_tpu.data.pipeline import preprocess
+
+    d, m = cfg.data, cfg.model
+    is_quantum = m.model in ("vqc", "qkernel")
+    n_features = d.n_features
+    features = d.features
+    if is_quantum:
+        if m.encoding == "amplitude" and m.model == "vqc":
+            n_features = n_features or (1 << m.n_qubits)
+        else:
+            n_features = n_features or m.n_qubits
+    else:
+        features = "image"
+
+    spec, train_xy, test_xy = load_dataset(d.dataset, d.raw_folder, seed=d.seed)
+    prep = preprocess(
+        train_xy,
+        test_xy,
+        classes=d.classes,
+        val_split=d.val_split,
+        features=features,
+        n_features=n_features,
+        seed=d.seed,
+    )
+    tr_x, tr_y = prep.train
+    if d.partition == "dirichlet":
+        parts = dirichlet_partition(tr_y, d.num_clients, d.alpha, seed=d.seed)
+    elif d.partition == "iid":
+        parts = iid_partition(len(tr_y), d.num_clients, seed=d.seed)
+    else:
+        raise ValueError(f"unknown partition {d.partition!r}")
+    cx, cy, cmask = pack_clients(tr_x, tr_y, parts, pad_multiple=cfg.fed.batch_size)
+    return {
+        "cx": cx,
+        "cy": cy,
+        "cmask": cmask,
+        "val": prep.val,
+        "test": prep.test,
+        "num_classes": prep.num_classes,
+        "spec": spec,
+        "stats": partition_stats(tr_y, parts, prep.num_classes),
+        "parts": parts,
+        "train": prep.train,
+    }

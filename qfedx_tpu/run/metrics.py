@@ -1,0 +1,108 @@
+"""Experiment tracking: JSONL metrics + run directories.
+
+The reference's observability is ``print()`` and a Python list of
+accuracies (reference src/CFed/Classical_FL.py:116-155; SURVEY.md §5
+Metrics row); MLflow and tensorboard are specified but unwired (reference
+ROADMAP.md:92-93, requirements.txt:11). Here every run gets a directory
+with ``config.json``, append-only ``metrics.jsonl`` (one JSON object per
+round — greppable, pandas-loadable, crash-safe), and ``summary.json``
+written at the end. No server, no daemon: artifacts are plain files, which
+is what survives on a TPU pod slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from pathlib import Path
+from typing import Any, Mapping
+
+
+def _jsonable(x: Any) -> Any:
+    if dataclasses.is_dataclass(x) and not isinstance(x, type):
+        return {k: _jsonable(v) for k, v in dataclasses.asdict(x).items()}
+    if isinstance(x, Mapping):
+        return {str(k): _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    if hasattr(x, "item") and getattr(x, "ndim", None) == 0:
+        return x.item()
+    if hasattr(x, "tolist"):
+        return x.tolist()
+    return x
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics stream; flushed per record (crash-safe)."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh = open(self.path, "a")
+
+    def log(self, record: Mapping[str, Any]) -> None:
+        rec = dict(_jsonable(record))
+        rec.setdefault("ts", time.time())
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class ExperimentRun:
+    """One tracked run: directory + config snapshot + metrics + summary.
+
+    Usage::
+
+        with ExperimentRun("runs", name="vqc8q", config=cfg) as run:
+            train_federated(..., on_round_end=run.on_round_end,
+                            checkpointer=run.checkpointer(every=5))
+            run.finish(final_accuracy=res.final_accuracy)
+    """
+
+    def __init__(
+        self, root: str | Path, name: str, config: Any = None, resume: bool = False
+    ):
+        self.dir = Path(root) / name
+        if self.dir.exists() and not resume:
+            stamp = time.strftime("%Y%m%d-%H%M%S")
+            self.dir = Path(root) / f"{name}-{stamp}"
+        self.dir.mkdir(parents=True, exist_ok=True)
+        if config is not None:
+            (self.dir / "config.json").write_text(
+                json.dumps(_jsonable(config), indent=2)
+            )
+        self.metrics = MetricsLogger(self.dir / "metrics.jsonl")
+        self._t0 = time.time()
+
+    def on_round_end(self, round_idx: int, metrics: Mapping[str, Any]) -> None:
+        self.metrics.log({"round": round_idx + 1, **metrics})
+
+    def checkpointer(self, every: int = 5, keep: int = 3):
+        from qfedx_tpu.run.checkpoint import Checkpointer
+
+        return Checkpointer(self.dir / "checkpoints", every=every, keep=keep)
+
+    def log_artifact(self, name: str, obj: Any) -> Path:
+        path = self.dir / name
+        path.write_text(json.dumps(_jsonable(obj), indent=2))
+        return path
+
+    def finish(self, **summary: Any) -> None:
+        summary = dict(summary)
+        summary["wall_time_s"] = time.time() - self._t0
+        (self.dir / "summary.json").write_text(json.dumps(_jsonable(summary), indent=2))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.metrics.close()

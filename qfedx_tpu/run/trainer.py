@@ -85,8 +85,23 @@ def train_federated(
             params, start_round = restored
 
     scx, scy, scm = shard_client_data(mesh, cx, cy, cmask)
+    # Pre-place params with the replicated sharding the round emits;
+    # otherwise round 2's input layout differs from round 1's (plain arrays
+    # from init vs NamedSharding from the round output) and XLA compiles the
+    # whole program a second time.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    params = jax.device_put(params, NamedSharding(mesh, P()))
 
     accountant = RDPAccountant() if cfg.dp is not None else None
+    if accountant is not None and start_round > 0:
+        # Resume must account for the privacy already spent by the rounds
+        # the checkpoint covers, or ε is underreported after restarts.
+        accountant.step(
+            q=cfg.client_fraction,
+            sigma=cfg.dp.noise_multiplier,
+            num_steps=start_round,
+        )
     n_params = trees.tree_size(params)
     # Per round: each participating client uploads Δθ and downloads θ
     # (ROADMAP.md:115's MB/round, exact in SPMD: one psum of |θ| floats).
@@ -118,7 +133,12 @@ def train_federated(
             result.accuracies.append(eval_metrics["accuracy"])
             metrics.update(eval_metrics)
         if checkpointer is not None:
-            checkpointer.maybe_save(rnd + 1, params)
+            # Always persist the final round — the weights final_accuracy is
+            # reported for must exist on disk even off the every-K cadence.
+            if rnd == num_rounds - 1:
+                checkpointer.save(rnd + 1, params)
+            else:
+                checkpointer.maybe_save(rnd + 1, params)
         if on_round_end is not None:
             on_round_end(rnd, metrics)
 

@@ -1,0 +1,136 @@
+"""Config system, CLI arg mapping, end-to-end `train` command, SPSA, viz."""
+
+import json
+
+import numpy as np
+import pytest
+
+from qfedx_tpu.data.partition import iid_partition, partition_stats
+from qfedx_tpu.data.viz import save_class_distribution, save_client_samples
+from qfedx_tpu.fed.config import FedConfig
+from qfedx_tpu.run.cli import build_parser, config_from_args, run_train
+from qfedx_tpu.run.config import (
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    build_data,
+    build_model,
+)
+
+
+def parse(argv):
+    return config_from_args(build_parser().parse_args(argv))
+
+
+def test_cli_maps_args():
+    cfg = parse(
+        [
+            "train", "--model", "vqc", "--qubits", "4", "--layers", "1",
+            "--dataset", "fashion_mnist", "--classes", "0,1", "--clients", "8",
+            "--partition", "dirichlet", "--alpha", "0.1", "--optimizer", "spsa",
+            "--algorithm", "fedprox", "--prox-mu", "0.05",
+            "--dp-clip", "0.5", "--dp-sigma", "2.0", "--secure-agg",
+        ]
+    )
+    assert cfg.model.n_qubits == 4 and cfg.data.dataset == "fashion_mnist"
+    assert cfg.data.classes == (0, 1) and cfg.data.partition == "dirichlet"
+    assert cfg.fed.optimizer == "spsa" and cfg.fed.algorithm == "fedprox"
+    assert cfg.fed.dp.clip_norm == 0.5 and cfg.fed.dp.noise_multiplier == 2.0
+    assert cfg.fed.secure_agg and cfg.fed.prox_mu == 0.05
+    assert "vqc4q" in cfg.run_name() and "fashion_mnist" in cfg.run_name()
+
+
+def test_build_data_quantum_and_classical_shapes():
+    base = dict(dataset="mnist", classes=(0, 1), num_clients=4, seed=1)
+    qcfg = ExperimentConfig(
+        data=DataConfig(features="pca", **base),
+        model=ModelConfig(model="vqc", n_qubits=4),
+        fed=FedConfig(batch_size=8),
+    )
+    qd = build_data(qcfg)
+    assert qd["cx"].shape[0] == 4 and qd["cx"].shape[2] == 4  # 4 PCA features
+    assert qd["cx"].shape[1] % 8 == 0  # padded to batch multiple
+    assert qd["num_classes"] == 2
+    assert (qd["cx"] >= 0).all() and (qd["cx"] <= 1).all()  # angle-ready
+
+    ccfg = ExperimentConfig(
+        data=DataConfig(**base),
+        model=ModelConfig(model="cnn"),
+        fed=FedConfig(batch_size=8),
+    )
+    cd = build_data(ccfg)
+    assert cd["cx"].shape[2:] == (28, 28)  # images kept for the CNN
+
+    model = build_model(ccfg, cd["num_classes"])
+    assert "cnn" in model.name
+    model = build_model(qcfg, qd["num_classes"])
+    assert "vqc" in model.name
+
+
+def test_build_model_kernel_and_noise():
+    cfg = ExperimentConfig(
+        model=ModelConfig(model="qkernel", n_qubits=3, n_landmarks=4)
+    )
+    assert "qkernel" in build_model(cfg, 2).name
+    noisy = ExperimentConfig(
+        model=ModelConfig(model="vqc", n_qubits=3, depolarizing_p=0.1)
+    )
+    assert "vqc" in build_model(noisy, 2).name
+
+
+def test_run_train_end_to_end(tmp_path):
+    """The full CLI path: synthetic data → SPMD training → run artifacts."""
+    cfg = parse(
+        [
+            "train", "--model", "vqc", "--qubits", "3", "--layers", "1",
+            "--classes", "0,1", "--clients", "4", "--rounds", "2",
+            "--local-epochs", "1", "--batch-size", "8", "--lr", "0.1",
+            "--optimizer", "adam", "--run-root", str(tmp_path), "--name", "t",
+        ]
+    )
+    summary = run_train(cfg)
+    assert 0.0 <= summary["final_accuracy"] <= 1.0
+    run_dir = tmp_path / "t"
+    assert (run_dir / "config.json").exists()
+    assert (run_dir / "summary.json").exists()
+    metrics = [
+        json.loads(l) for l in (run_dir / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert len(metrics) == 2 and metrics[-1]["round"] == 2
+
+
+def test_spsa_trains():
+    """SPSA gradient estimation drives loss down on a separable task."""
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+    from qfedx_tpu.run.trainer import train_federated
+
+    rng = np.random.default_rng(0)
+    clients, samples, nq = 4, 32, 2
+    cx = rng.uniform(0, 1, (clients, samples, nq)).astype(np.float32)
+    cy = (cx[..., 0] > 0.5).astype(np.int32)
+    cm = np.ones((clients, samples), dtype=np.float32)
+    tx = rng.uniform(0, 1, (64, nq)).astype(np.float32)
+    ty = (tx[:, 0] > 0.5).astype(np.int32)
+    model = make_vqc_classifier(nq, n_layers=1, num_classes=2)
+    cfg = FedConfig(
+        local_epochs=2, batch_size=8, learning_rate=0.3, optimizer="spsa",
+        momentum=0.0, spsa_c=0.15,
+    )
+    res = train_federated(model, cfg, cx, cy, cm, tx, ty, num_rounds=12, seed=3)
+    assert res.losses[-1] < res.losses[0]
+    assert res.final_accuracy > 0.6, res.accuracies
+
+
+def test_viz_outputs(tmp_path):
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 1, (40, 8, 8)).astype(np.float32)
+    y = rng.integers(0, 3, 40)
+    parts = iid_partition(40, 4, seed=0)
+    p1 = save_client_samples(x, parts, tmp_path / "samples.png")
+    stats = partition_stats(y, parts, 3)
+    p2 = save_class_distribution(stats, tmp_path / "dist.png")
+    assert p1.exists() and p1.stat().st_size > 0
+    assert p2.exists() and p2.stat().st_size > 0
+    flat = rng.uniform(0, 1, (40, 6)).astype(np.float32)  # non-square features
+    p3 = save_client_samples(flat, parts, tmp_path / "flat.png")
+    assert p3.exists()

@@ -1,0 +1,157 @@
+"""Noise models: analytic ⟨Z⟩ maps, Kraus trajectory sampling, shots.
+
+Covers the reference's noise-phase spec (reference ROADMAP.md:64-73),
+including its own acceptance check that noise degrades accuracy monotonically
+in strength (ROADMAP.md:73) — here as expectation shrinkage — and
+cross-checks the cheap analytic readout channels against the general
+trajectory engine.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qfedx_tpu.circuits.encoders import angle_encode
+from qfedx_tpu.models.vqc import make_vqc_classifier
+from qfedx_tpu.noise import (
+    NoiseModel,
+    amplitude_damping_kraus,
+    apply_channel,
+    apply_channel_all,
+    bit_flip_kraus,
+    depolarizing_kraus,
+    trajectory_average,
+)
+from qfedx_tpu.ops import statevector as sv
+from qfedx_tpu.ops.cpx import from_complex
+
+
+def random_state(n, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(2,) * n) + 1j * rng.normal(size=(2,) * n)
+    return from_complex(x / np.linalg.norm(x))
+
+
+# --- analytic channel maps -------------------------------------------------
+
+
+def test_depolarizing_shrinks_z():
+    z = jnp.asarray([0.8, -0.4])
+    nm = NoiseModel(depolarizing_p=0.25)
+    np.testing.assert_allclose(nm.apply_to_z(z, None), 0.75 * z, atol=1e-6)
+
+
+def test_amplitude_damping_pulls_toward_zero_state():
+    z = jnp.asarray([-1.0, 0.0, 1.0])
+    nm = NoiseModel(amp_damping_gamma=0.5)
+    # ⟨Z⟩ → ⟨Z⟩ + γ(1−⟨Z⟩); |0⟩ (z=1) is the fixed point.
+    np.testing.assert_allclose(nm.apply_to_z(z, None), [0.0, 0.5, 1.0], atol=1e-6)
+
+
+def test_readout_confusion_symmetric():
+    z = jnp.asarray([0.6])
+    nm = NoiseModel(readout_e01=0.1, readout_e10=0.1)
+    np.testing.assert_allclose(nm.apply_to_z(z, None), 0.8 * z, atol=1e-6)
+
+
+def test_noise_strength_monotone():
+    """Reference ROADMAP.md:73: stronger noise ⇒ more degradation."""
+    z = jnp.asarray([0.9])
+    vals = [
+        float(NoiseModel(depolarizing_p=p).apply_to_z(z, None)[0])
+        for p in (0.0, 0.1, 0.3, 0.6)
+    ]
+    assert vals == sorted(vals, reverse=True)
+
+
+def test_finite_shots_unbiased_and_noisy():
+    z = jnp.asarray([0.4] * 64)
+    nm = NoiseModel(shots=256)
+    out = nm.apply_to_z(z, jax.random.PRNGKey(0))
+    assert float(jnp.std(out)) > 0.0  # actually sampled
+    np.testing.assert_allclose(float(jnp.mean(out)), 0.4, atol=0.05)
+    assert NoiseModel(shots=None).apply_to_z(z, None) is z  # exact path
+
+
+def test_shots_require_key():
+    with pytest.raises(ValueError, match="key"):
+        NoiseModel(shots=16).apply_to_z(jnp.asarray([0.0]), None)
+
+
+# --- trajectory engine -----------------------------------------------------
+
+
+def test_trajectory_preserves_norm():
+    state = random_state(4, seed=1)
+    out = apply_channel(state, depolarizing_kraus(0.3), 2, jax.random.PRNGKey(0))
+    np.testing.assert_allclose(float(jnp.sum(sv.cabs2(out))), 1.0, atol=1e-5)
+
+
+def test_trajectory_depolarizing_matches_analytic():
+    """E_traj[⟨Z⟩] = (1−p)·⟨Z⟩ for the depolarizing channel."""
+    n, p, qubit = 3, 0.4, 1
+    state = random_state(n, seed=2)
+    z_clean = float(sv.expect_z(state, qubit))
+
+    est = trajectory_average(
+        lambda key: sv.expect_z(
+            apply_channel(state, depolarizing_kraus(p), qubit, key), qubit
+        ),
+        n_trajectories=3000,
+    )
+    z_noisy = float(est(jax.random.PRNGKey(3)))
+    np.testing.assert_allclose(z_noisy, (1.0 - p) * z_clean, atol=0.05)
+
+
+def test_trajectory_damping_matches_analytic():
+    n, gamma, qubit = 2, 0.35, 0
+    state = random_state(n, seed=4)
+    z_clean = float(sv.expect_z(state, qubit))
+    est = trajectory_average(
+        lambda key: sv.expect_z(
+            apply_channel(state, amplitude_damping_kraus(gamma), qubit, key), qubit
+        ),
+        n_trajectories=3000,
+    )
+    z_noisy = float(est(jax.random.PRNGKey(5)))
+    np.testing.assert_allclose(z_noisy, z_clean + gamma * (1.0 - z_clean), atol=0.05)
+
+
+def test_bit_flip_full_strength_flips_z():
+    state = angle_encode(jnp.asarray([0.0, 0.0]))  # |00⟩, ⟨Z⟩=+1 each
+    out = apply_channel_all(state, bit_flip_kraus(1.0), jax.random.PRNGKey(0))
+    np.testing.assert_allclose(np.asarray(sv.expect_z_all(out)), [-1, -1], atol=1e-5)
+
+
+# --- model integration -----------------------------------------------------
+
+
+def test_vqc_with_finite_shots_trains_and_evals():
+    """shots-enabled VQC: eval is exact (deterministic), training samples
+    shot noise through apply_train (regression: apply() used to crash)."""
+    model = make_vqc_classifier(
+        3, n_layers=1, num_classes=2, noise_model=NoiseModel(shots=64)
+    )
+    params = model.init(jax.random.PRNGKey(0))
+    x = jnp.linspace(0.1, 0.9, 6).reshape(2, 3)
+    l1, l2 = model.apply(params, x), model.apply(params, x)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2))  # exact eval
+    assert model.apply_train is not None
+    lt1 = model.apply_train(params, x, jax.random.PRNGKey(1))
+    lt2 = model.apply_train(params, x, jax.random.PRNGKey(2))
+    assert not np.allclose(np.asarray(lt1), np.asarray(lt2))  # sampled
+
+
+def test_vqc_with_noise_model_runs_and_degrades():
+    x = jnp.linspace(0.1, 0.9, 8).reshape(2, 4)
+    clean = make_vqc_classifier(4, n_layers=1, num_classes=2)
+    noisy = make_vqc_classifier(
+        4, n_layers=1, num_classes=2, noise_model=NoiseModel(depolarizing_p=0.3)
+    )
+    params = clean.init(jax.random.PRNGKey(0))
+    lc = clean.apply(params, x)
+    ln = noisy.apply(params, x)
+    assert lc.shape == ln.shape == (2, 2)
+    # depolarizing shrinks ⟨Z⟩ ⇒ logits move toward the bias (0 here)
+    assert float(jnp.sum(jnp.abs(ln))) < float(jnp.sum(jnp.abs(lc)))
