@@ -6,3 +6,8 @@ from qfedx_tpu.models.kernel import (  # noqa: F401
     kernel_matrix,
     make_quantum_kernel_classifier,
 )
+from qfedx_tpu.models.vqc_sharded import (  # noqa: F401
+    fed_mesh_2d,
+    host_apply,
+    make_sharded_vqc_classifier,
+)

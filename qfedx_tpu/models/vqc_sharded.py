@@ -1,0 +1,124 @@
+"""VQC classifier on the device-sharded statevector engine.
+
+The model for the reference roadmap's ≥20-qubit regime (reference
+ROADMAP.md:86: dense statevector capped at ~20 qubits on one device;
+BASELINE.md config 5): same parameter pytree, circuit structure, and
+readout as ``models.vqc`` (hardware-efficient ansatz + ⟨Z⟩→logit), but the
+forward pass simulates on a state sharded over an ``"sv"`` mesh axis
+(parallel.sharded) — gates on device-resident qubits become ``ppermute``
+pair exchanges, readout a ``psum``.
+
+Composition with federation: this Model's ``apply`` contains ``sv``-axis
+collectives, so it must be traced inside a ``shard_map`` whose mesh carries
+that axis. ``fed.round.make_fed_round`` is already such a context — pass it
+a 2-D mesh ``(clients, sv)`` and this model, and the one-program federated
+round runs data parallelism (clients) × state parallelism (sv)
+simultaneously: client data shards over ``clients`` and replicates over
+``sv``; every sv-peer computes the same local update redundantly (same
+inputs, same collectives), so aggregation over ``clients`` alone stays
+exact. For host-side use (evaluation), ``host_apply`` wraps the forward in
+its own shard_map over the sv axis.
+
+Since a single sample's state occupies the whole sv group, samples batch
+with ``vmap`` *around* the collective choreography (ppermute/psum batch
+cleanly — same permutation per element).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from qfedx_tpu.circuits.ansatz import init_ansatz_params
+from qfedx_tpu.circuits.readout import init_readout_params
+from qfedx_tpu.models.api import Model
+from qfedx_tpu.models.vqc import wrap_angle
+from qfedx_tpu.parallel.circuit import sharded_hea_state
+from qfedx_tpu.parallel.sharded import ShardCtx, expect_z_all_sharded, pmean_grad
+
+
+def make_sharded_vqc_classifier(
+    n_qubits: int,
+    sv_size: int,
+    n_layers: int = 2,
+    num_classes: int = 2,
+    sv_axis: str = "sv",
+    init_scale: float = 0.1,
+) -> Model:
+    """VQC Model whose forward runs on an ``sv_size``-way sharded state.
+
+    ``sv_size`` must be a power of two with ≥2 local qubits left over.
+    ``apply`` REQUIRES an enclosing shard_map carrying ``sv_axis``.
+    """
+    if num_classes > n_qubits:
+        raise ValueError(f"need n_qubits ≥ num_classes ({num_classes})")
+    n_global = (sv_size - 1).bit_length()
+    if 1 << n_global != sv_size:
+        raise ValueError(f"sv_size {sv_size} is not a power of two")
+    if n_qubits - n_global < 2:
+        raise ValueError("need ≥2 local qubits for sharded 2q gates")
+    ctx = ShardCtx(axis=sv_axis, n_qubits=n_qubits, n_global=n_global)
+
+    def init(key: jax.Array):
+        k_ansatz, k_read = jax.random.split(key)
+        return {
+            "ansatz": init_ansatz_params(k_ansatz, n_qubits, n_layers, init_scale),
+            "readout": init_readout_params(k_read, num_classes),
+        }
+
+    def apply_one(params, x):
+        state = sharded_hea_state(ctx, x, params["ansatz"])
+        z = expect_z_all_sharded(ctx, state)[:num_classes]
+        return params["readout"]["scale"] * z + params["readout"]["bias"]
+
+    def apply(params, x):
+        # Gradient correctness under sharding: see pmean_grad — repairs the
+        # per-device partial + psum-transpose scaling so parameter gradients
+        # come out replicated and exact.
+        params = jax.tree.map(lambda p: pmean_grad(p, sv_axis), params)
+        return jax.vmap(lambda xi: apply_one(params, xi))(x)
+
+    def wrap_delta(delta):
+        return {
+            "ansatz": {k: wrap_angle(v) for k, v in delta["ansatz"].items()},
+            "readout": delta["readout"],
+        }
+
+    return Model(
+        init=init,
+        apply=apply,
+        wrap_delta=wrap_delta,
+        name=f"svqc{n_qubits}q{n_layers}l-sv{sv_size}",
+    )
+
+
+def host_apply(model: Model, mesh: Mesh, sv_axis: str = "sv"):
+    """Jitted host-callable ``(params, x) -> logits`` for a sharded model.
+
+    Wraps ``model.apply`` in a shard_map over the full mesh with everything
+    replicated — the sv collectives run inside, the result is identical on
+    every device. Use for evaluation (fed.evaluate.make_evaluator assumes a
+    host-callable apply).
+    """
+
+    def wrapped(params, x):
+        return jax.shard_map(
+            model.apply,
+            mesh=mesh,
+            in_specs=(P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )(params, x)
+
+    return jax.jit(wrapped)
+
+
+def fed_mesh_2d(num_client_devices: int, sv_size: int, devices=None) -> Mesh:
+    """(clients, sv) mesh over a device subset — delegates to
+    parallel.mesh.fed_mesh (one mesh constructor, one topology policy)."""
+    from qfedx_tpu.parallel.mesh import fed_mesh
+
+    return fed_mesh(
+        sv_size=sv_size, num_client_devices=num_client_devices, devices=devices
+    )

@@ -14,3 +14,9 @@ from qfedx_tpu.parallel.circuit import (  # noqa: F401
     make_sharded_forward,
     sharded_hea_state,
 )
+from qfedx_tpu.parallel.mesh import (  # noqa: F401
+    distributed_init,
+    fed_mesh,
+    hybrid_fed_mesh,
+)
+from qfedx_tpu.parallel.sharded import pmean_grad  # noqa: F401

@@ -1,0 +1,113 @@
+"""Mesh construction for single-host, multi-host, and multi-slice TPU.
+
+The reference's scale-out plan is Slurm arrays + Ray RPC (reference
+ROADMAP.md:75-96) — host-side orchestration. The TPU-native replacement is
+topology-aware device meshes: the same one-program federated round runs
+unchanged at every scale; only the mesh changes.
+
+Axis placement policy (bandwidth-driven):
+
+- ``sv`` (statevector sharding) exchanges half a state per gate on a
+  device-resident qubit — it MUST ride ICI. Keep each sv group inside one
+  slice, contiguous.
+- ``clients`` (federated data parallelism) communicates exactly once per
+  round (one psum of |θ| floats) — it tolerates DCN. Across slices, put
+  ``clients`` outermost; XLA then routes the round's single all-reduce
+  hierarchically (ICI within slices, DCN between).
+
+This is the standard hybrid-mesh recipe (ICI-heavy axes inner, DCN-tolerant
+axes outer) applied to federated QML.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def distributed_init(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize multi-host JAX (one process per host).
+
+    Thin wrapper over ``jax.distributed.initialize``; on TPU pods the
+    arguments are auto-detected from the environment, so call with no args
+    from every host before touching devices. Idempotent-safe guard
+    included so library code can call it defensively.
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # Repeat call: jax raises "distributed.initialize should only be
+        # called once." (message has varied across versions — match both).
+        msg = str(e).lower()
+        if "once" not in msg and "already" not in msg:
+            raise
+
+
+def fed_mesh(
+    sv_size: int = 1,
+    clients_axis: str = "clients",
+    sv_axis: str = "sv",
+    num_client_devices: int | None = None,
+    devices=None,
+) -> Mesh:
+    """(clients, sv) mesh — by default over ALL global devices.
+
+    ``sv_size`` = 1 gives pure client parallelism. Otherwise devices are
+    grouped so each sv group is a contiguous run of ``jax.devices()`` —
+    which JAX orders ICI-adjacent within a slice — and the clients axis
+    spans the remaining (possibly DCN-crossing) dimension.
+    ``num_client_devices`` restricts the mesh to the first
+    ``num_client_devices × sv_size`` devices (subset meshes for tests/
+    benchmarks).
+    """
+    devs = jax.devices() if devices is None else devices
+    n = len(devs)
+    if num_client_devices is not None:
+        need = num_client_devices * sv_size
+        if n < need:
+            raise ValueError(f"need {need} devices, have {n}")
+        devs, n = devs[:need], need
+    if n % sv_size != 0:
+        raise ValueError(f"{n} devices not divisible by sv_size={sv_size}")
+    arr = np.array(devs).reshape(n // sv_size, sv_size)
+    return Mesh(arr, (clients_axis, sv_axis))
+
+
+def hybrid_fed_mesh(
+    sv_size: int = 1,
+    clients_axis: str = "clients",
+    sv_axis: str = "sv",
+) -> Mesh:
+    """Multi-slice-aware (clients, sv) mesh.
+
+    Uses ``mesh_utils.create_hybrid_device_mesh`` when more than one slice
+    is present so the clients axis crosses DCN and the sv axis never does;
+    falls back to ``fed_mesh`` on a single slice/host.
+    """
+    devs = jax.devices()
+    num_slices = len({getattr(d, "slice_index", 0) for d in devs})
+    if num_slices <= 1:
+        return fed_mesh(sv_size, clients_axis, sv_axis)
+    from jax.experimental import mesh_utils
+
+    per_slice = len(devs) // num_slices
+    if per_slice % sv_size != 0:
+        raise ValueError(
+            f"sv groups must fit within a slice: {per_slice} chips/slice, "
+            f"sv_size={sv_size}"
+        )
+    arr = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(per_slice // sv_size, sv_size),
+        dcn_mesh_shape=(num_slices, 1),
+        devices=devs,
+    )
+    return Mesh(arr, (clients_axis, sv_axis))

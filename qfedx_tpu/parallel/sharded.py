@@ -24,6 +24,7 @@ dense (2,)*n tensor, so dense↔sharded round-trips are pure reshapes.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -31,6 +32,38 @@ import jax.numpy as jnp
 
 from qfedx_tpu.ops.cpx import CArray, RDTYPE, cabs2
 from qfedx_tpu.ops import statevector as sv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def pmean_grad(x, axis: str):
+    """Identity whose VJP pmeans the cotangent over ``axis``.
+
+    Differentiating *inside* a ``shard_map`` (vma checking off), replicated
+    parameters come out with device-dependent cotangents: a path that
+    crosses the forward's observable psum picks up both a per-device
+    partial and a factor of axis-size from psum's self-transpose
+    (n·∂f_local/∂θ per device), while a path that stays replicated (e.g.
+    readout scale/bias applied after the psum) is already exact. pmean
+    repairs both at once: (1/n)·Σ_devices n·∂f_dev = Σ ∂f_dev on crossed
+    paths, identity on replicated ones.
+
+    Invariant required: at most ONE observable psum between the parameter
+    and the loss (true for encoder→ansatz→⟨Z⟩ circuits — ppermutes
+    transpose to ppermutes with no scaling). Verified against the dense
+    engine in tests/test_fed_sharded.py.
+    """
+    return x
+
+
+def _pmean_grad_fwd(x, axis):
+    return x, None
+
+
+def _pmean_grad_bwd(axis, _, ct):
+    return (jax.lax.pmean(ct, axis),)
+
+
+pmean_grad.defvjp(_pmean_grad_fwd, _pmean_grad_bwd)
 
 
 class ShardCtx(NamedTuple):

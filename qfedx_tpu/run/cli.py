@@ -78,6 +78,8 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--name", default=None)
     t.add_argument("--resume", action="store_true",
                    help="reuse the --name run dir and resume from its latest checkpoint")
+    t.add_argument("--plots", action="store_true",
+                   help="save client-sample and class-distribution PNGs to the run dir")
     return p
 
 
@@ -129,7 +131,7 @@ def config_from_args(a: argparse.Namespace) -> ExperimentConfig:
     )
 
 
-def run_train(cfg: ExperimentConfig, resume: bool = False) -> dict:
+def run_train(cfg: ExperimentConfig, resume: bool = False, plots: bool = False) -> dict:
     from qfedx_tpu.fed.evaluate import make_evaluator
     from qfedx_tpu.run.metrics import ExperimentRun
     from qfedx_tpu.run.trainer import train_federated
@@ -145,6 +147,17 @@ def run_train(cfg: ExperimentConfig, resume: bool = False) -> dict:
 
     with ExperimentRun(cfg.run_root, cfg.run_name(), config=cfg, resume=resume) as run:
         print(f"[qfedx_tpu] run dir: {run.dir}")
+        if plots:
+            # Reference-parity data inspection artifacts
+            # (src/CFed/Preprocess.py:71-134 saves the same two PNGs).
+            from qfedx_tpu.data.viz import (
+                save_class_distribution,
+                save_client_samples,
+            )
+
+            tr_x, _ = data["train"]
+            save_client_samples(tr_x, data["parts"], run.dir / "client_samples.png")
+            save_class_distribution(data["stats"], run.dir / "class_distribution.png")
         print(
             f"[qfedx_tpu] model={model.name} clients={data['cx'].shape[0]} "
             f"samples/client≤{data['cx'].shape[1]} classes={data['num_classes']}"
@@ -189,7 +202,7 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
     if args.cmd == "train":
         cfg = config_from_args(args)
-        run_train(cfg, resume=args.resume)
+        run_train(cfg, resume=args.resume, plots=args.plots)
 
 
 if __name__ == "__main__":

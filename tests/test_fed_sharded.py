@@ -1,0 +1,112 @@
+"""2-D mesh federation: clients axis × sharded-statevector axis.
+
+The combined parallelism program (SURVEY.md §7.3.1 + §7.3.5): federated
+clients as one mesh axis, each client's quantum state sharded over the
+other. Correctness anchor: the sharded VQC must produce the same logits and
+the same federated round as the dense VQC with identical parameters.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qfedx_tpu.fed.config import FedConfig
+from qfedx_tpu.fed.round import make_fed_round, shard_client_data
+from qfedx_tpu.models.vqc import make_vqc_classifier
+from qfedx_tpu.models.vqc_sharded import (
+    fed_mesh_2d,
+    host_apply,
+    make_sharded_vqc_classifier,
+)
+
+N_QUBITS = 5  # 2 global (sv=4), 3 local
+
+
+@pytest.fixture(scope="module")
+def mesh2d():
+    return fed_mesh_2d(num_client_devices=2, sv_size=4)
+
+
+@pytest.fixture(scope="module")
+def models():
+    dense = make_vqc_classifier(N_QUBITS, n_layers=2, num_classes=2)
+    sharded = make_sharded_vqc_classifier(
+        N_QUBITS, sv_size=4, n_layers=2, num_classes=2
+    )
+    return dense, sharded
+
+
+def test_sharded_apply_matches_dense(mesh2d, models):
+    dense, sharded = models
+    params = dense.init(jax.random.PRNGKey(0))
+    assert jax.tree.structure(params) == jax.tree.structure(
+        sharded.init(jax.random.PRNGKey(0))
+    )
+    x = jnp.asarray(
+        np.random.default_rng(0).uniform(0, 1, (6, N_QUBITS)), dtype=jnp.float32
+    )
+    got = np.asarray(host_apply(sharded, mesh2d)(params, x))
+    want = np.asarray(dense.apply(params, x))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_fed_round_2d_matches_dense_1d(mesh2d, models):
+    """One federated round on the (2, 4) mesh ≡ the same round computed with
+    the dense model on a 1-D client mesh (same params, data, keys)."""
+    dense, sharded = models
+    clients, samples = 4, 8
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1, momentum=0.0)
+    rng = np.random.default_rng(1)
+    cx = rng.uniform(0, 1, (clients, samples, N_QUBITS)).astype(np.float32)
+    cy = rng.integers(0, 2, (clients, samples)).astype(np.int32)
+    cm = np.ones((clients, samples), dtype=np.float32)
+    params = dense.init(jax.random.PRNGKey(7))
+    rkey = jax.random.PRNGKey(9)
+
+    round_2d = make_fed_round(sharded, cfg, mesh2d, num_clients=clients)
+    sx, sy, sm = shard_client_data(mesh2d, cx, cy, jnp.asarray(cm))
+    p2d, stats2d = round_2d(params, sx, sy, sm, rkey)
+
+    from qfedx_tpu.fed.round import client_mesh
+
+    mesh1d = client_mesh(num_devices=4)
+    round_1d = make_fed_round(dense, cfg, mesh1d, num_clients=clients)
+    dx, dy, dm = shard_client_data(mesh1d, cx, cy, jnp.asarray(cm))
+    p1d, stats1d = round_1d(params, dx, dy, dm, rkey)
+
+    np.testing.assert_allclose(
+        float(stats2d.mean_loss), float(stats1d.mean_loss), atol=1e-4
+    )
+    for a, b in zip(jax.tree.leaves(p2d), jax.tree.leaves(p1d)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_fed_round_2d_converges(mesh2d, models):
+    """Multi-round training on the 2-D mesh drives the loss down."""
+    _, sharded = models
+    clients, samples = 4, 16
+    cfg = FedConfig(
+        local_epochs=2, batch_size=8, learning_rate=0.2, optimizer="adam"
+    )
+    rng = np.random.default_rng(2)
+    cx = rng.uniform(0, 1, (clients, samples, N_QUBITS)).astype(np.float32)
+    cy = (cx[..., 0] > 0.5).astype(np.int32)
+    cm = np.ones((clients, samples), dtype=np.float32)
+    round_fn = make_fed_round(sharded, cfg, mesh2d, num_clients=clients)
+    sx, sy, sm = shard_client_data(mesh2d, cx, cy, jnp.asarray(cm))
+    params = sharded.init(jax.random.PRNGKey(0))
+    losses = []
+    for r in range(8):
+        params, stats = round_fn(params, sx, sy, sm, jax.random.PRNGKey(100 + r))
+        losses.append(float(stats.mean_loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_mesh_validation():
+    with pytest.raises(ValueError, match="power of two"):
+        make_sharded_vqc_classifier(6, sv_size=3)
+    with pytest.raises(ValueError, match="local qubits"):
+        make_sharded_vqc_classifier(3, sv_size=4)
+    with pytest.raises(ValueError, match="devices"):
+        fed_mesh_2d(num_client_devices=4, sv_size=4)

@@ -1,0 +1,28 @@
+"""Mesh construction helpers (single-host paths on the virtual 8-CPU mesh)."""
+
+import jax
+import numpy as np
+import pytest
+
+from qfedx_tpu.parallel.mesh import fed_mesh, hybrid_fed_mesh
+
+
+def test_fed_mesh_shapes():
+    m = fed_mesh(sv_size=1)
+    assert m.shape == {"clients": 8, "sv": 1}
+    m = fed_mesh(sv_size=4)
+    assert m.shape == {"clients": 2, "sv": 4}
+    # sv groups are contiguous device runs (ICI-adjacency proxy)
+    arr = np.array(m.devices).reshape(2, 4)
+    ids = [[d.id for d in row] for row in arr]
+    assert ids[0] == sorted(ids[0]) and ids[1] == sorted(ids[1])
+
+
+def test_fed_mesh_divisibility():
+    with pytest.raises(ValueError, match="divisible"):
+        fed_mesh(sv_size=3)
+
+
+def test_hybrid_falls_back_on_single_slice():
+    m = hybrid_fed_mesh(sv_size=2)
+    assert m.shape == {"clients": 4, "sv": 2}
