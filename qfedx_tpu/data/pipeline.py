@@ -151,6 +151,27 @@ class Preprocessed:
     test: tuple[np.ndarray, np.ndarray]
     num_classes: int
 
+    def save(self, path) -> None:
+        """Persist all splits to one compressed .npz (the reference saves
+        train/val/test.pt via torch.save, Preprocess.py:192-199)."""
+        np.savez_compressed(
+            path,
+            train_x=self.train[0], train_y=self.train[1],
+            val_x=self.val[0], val_y=self.val[1],
+            test_x=self.test[0], test_y=self.test[1],
+            num_classes=np.int64(self.num_classes),
+        )
+
+    @classmethod
+    def load(cls, path) -> "Preprocessed":
+        with np.load(path) as d:
+            return cls(
+                train=(d["train_x"], d["train_y"]),
+                val=(d["val_x"], d["val_y"]),
+                test=(d["test_x"], d["test_y"]),
+                num_classes=int(d["num_classes"]),
+            )
+
 
 def preprocess(
     train_xy,

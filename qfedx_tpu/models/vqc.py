@@ -98,8 +98,54 @@ def make_vqc_classifier(
 
         return jax.vmap(one)(x)
 
+    circuit_noise = (
+        noise_model is not None
+        and noise_model.circuit_level
+        and len(noise_model.kraus_channels()) > 0
+    )
+    if circuit_noise and encoding == "reupload":
+        raise ValueError("circuit-level noise supports angle/amplitude encodings")
+
+    def noisy_forward_state(params, x, key):
+        """Trajectory forward: sampled Kraus channels after every layer."""
+        from qfedx_tpu.circuits.ansatz import ansatz_layer
+        from qfedx_tpu.noise.trajectory import apply_channel_all
+
+        enc = angle_encode(x, basis) if encoding == "angle" else amplitude_encode(x)
+        state = enc
+        channels = noise_model.kraus_channels()
+        n_layers_ = params["ansatz"]["rx"].shape[0]
+        for layer in range(n_layers_):
+            state = ansatz_layer(
+                state, params["ansatz"]["rx"][layer], params["ansatz"]["rz"][layer]
+            )
+            for ci, kraus in enumerate(channels):
+                state = apply_channel_all(
+                    state, kraus, jax.random.fold_in(key, layer * 8 + ci)
+                )
+        return state
+
     apply_train = None
-    if noise_model is not None and noise_model.shots is not None:
+    if circuit_noise:
+        # Readout still applies confusion/shots; the channels already acted
+        # on the state, so exclude their analytic maps to avoid double noise.
+        from dataclasses import replace as _dc_replace
+
+        readout_noise = _dc_replace(
+            noise_model, depolarizing_p=0.0, amp_damping_gamma=0.0
+        )
+
+        def apply_train(params, x, key):
+            keys = jax.random.split(key, x.shape[0])
+
+            def one(xi, k):
+                k_traj, k_shot = jax.random.split(k)
+                state = noisy_forward_state(params, xi, k_traj)
+                return readout_noise.noisy_logits(state, params["readout"], k_shot)
+
+            return jax.vmap(one)(x, keys)
+
+    elif noise_model is not None and noise_model.shots is not None:
 
         def apply_train(params, x, key):
             keys = jax.random.split(key, x.shape[0])

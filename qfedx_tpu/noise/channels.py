@@ -105,6 +105,21 @@ class NoiseModel:
     readout_e01: float = 0.0  # P(read 1 | true 0)
     readout_e10: float = 0.0  # P(read 0 | true 1)
     shots: int | None = None
+    # circuit_level=True: during training, depolarizing/damping are applied
+    # as sampled Kraus trajectories after every ansatz layer
+    # (noise.trajectory) instead of as analytic readout maps — the
+    # reference roadmap's "insert noise ops in circuits" placement
+    # (ROADMAP.md:66). Evaluation stays analytic (exact channel average).
+    circuit_level: bool = False
+
+    def kraus_channels(self) -> list:
+        """Stacked Kraus sets for the circuit-level channels that are on."""
+        out = []
+        if self.depolarizing_p > 0.0:
+            out.append(depolarizing_kraus(self.depolarizing_p))
+        if self.amp_damping_gamma > 0.0:
+            out.append(amplitude_damping_kraus(self.amp_damping_gamma))
+        return out
 
     def exact_shots(self) -> "NoiseModel":
         """This model in the infinite-shot limit (for deterministic eval)."""

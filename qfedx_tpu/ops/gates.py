@@ -91,6 +91,26 @@ def rz(theta) -> CArray:
 ROTATIONS = {"rx": rx, "ry": ry, "rz": rz}
 
 
+def rot_zx(theta, phi) -> CArray:
+    """RZ(φ)·RX(θ) fused into one 2×2 gate.
+
+    The hardware-efficient ansatz applies RX then RZ on every qubit
+    (reference ROADMAP.md:126-127); composing them at the 2×2 level halves
+    the number of state-sized contractions per layer — the dominant cost of
+    a layer. Entries (a=cos φ/2, b=sin φ/2, c=cos θ/2, s=sin θ/2):
+
+        [[ (a−ib)c , −i(a−ib)s ],        re [[ ac, −bs],[ bs, ac]]
+         [ −i(a+ib)s , (a+ib)c ]]   ⇒    im [[−bc, −as],[−as, bc]]
+    """
+    theta = jnp.asarray(theta, dtype=RDTYPE)
+    phi = jnp.asarray(phi, dtype=RDTYPE)
+    c, s = jnp.cos(theta / 2), jnp.sin(theta / 2)
+    a, b = jnp.cos(phi / 2), jnp.sin(phi / 2)
+    re = jnp.stack([jnp.stack([a * c, -b * s]), jnp.stack([b * s, a * c])])
+    im = jnp.stack([jnp.stack([-b * c, -a * s]), jnp.stack([-a * s, b * c])])
+    return CArray(re, im)
+
+
 def crz(theta) -> CArray:
     """Controlled-RZ as a (2,2,2,2) tensor (control = first index pair)."""
     theta = jnp.asarray(theta, dtype=RDTYPE)

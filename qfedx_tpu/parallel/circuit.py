@@ -35,8 +35,12 @@ def sharded_hea_state(ctx: ShardCtx, features: jnp.ndarray, params: dict):
     n_layers = params["rx"].shape[0]
     for layer in range(n_layers):
         for q in range(n):
-            state = apply_gate_sharded(ctx, state, gates.rx(params["rx"][layer, q]), q)
-            state = apply_gate_sharded(ctx, state, gates.rz(params["rz"][layer, q]), q)
+            state = apply_gate_sharded(
+                ctx,
+                state,
+                gates.rot_zx(params["rx"][layer, q], params["rz"][layer, q]),
+                q,
+            )
         if n >= 2:
             for q in range(n - 1):
                 state = apply_gate_2q_sharded(ctx, state, gates.CNOT, q, q + 1)

@@ -57,6 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--damping", type=float, default=0.0)
     t.add_argument("--readout-flip", type=float, default=0.0)
     t.add_argument("--shots", type=int, default=None)
+    t.add_argument("--noise-placement", default="readout",
+                   choices=["readout", "circuit"],
+                   help="analytic readout maps vs sampled Kraus trajectories in-circuit")
     # federated
     t.add_argument("--rounds", type=int, default=30)
     t.add_argument("--local-epochs", type=int, default=5)
@@ -80,6 +83,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="reuse the --name run dir and resume from its latest checkpoint")
     t.add_argument("--plots", action="store_true",
                    help="save client-sample and class-distribution PNGs to the run dir")
+    t.add_argument("--profile", action="store_true",
+                   help="capture a jax.profiler trace of the training rounds into the run dir")
+
+    d = sub.add_parser("demo", help="encoder walkthrough (reference testEncoder parity)")
+    d.add_argument("--dataset", default="mnist",
+                   choices=["mnist", "fashion_mnist", "cifar10"])
+    d.add_argument("--out", default="runs/demo")
     return p
 
 
@@ -110,6 +120,7 @@ def config_from_args(a: argparse.Namespace) -> ExperimentConfig:
             amp_damping_gamma=a.damping,
             readout_flip=a.readout_flip,
             shots=a.shots,
+            noise_placement=a.noise_placement,
         ),
         fed=FedConfig(
             local_epochs=a.local_epochs,
@@ -131,7 +142,12 @@ def config_from_args(a: argparse.Namespace) -> ExperimentConfig:
     )
 
 
-def run_train(cfg: ExperimentConfig, resume: bool = False, plots: bool = False) -> dict:
+def run_train(
+    cfg: ExperimentConfig,
+    resume: bool = False,
+    plots: bool = False,
+    profile: bool = False,
+) -> dict:
     from qfedx_tpu.fed.evaluate import make_evaluator
     from qfedx_tpu.run.metrics import ExperimentRun
     from qfedx_tpu.run.trainer import train_federated
@@ -162,23 +178,29 @@ def run_train(cfg: ExperimentConfig, resume: bool = False, plots: bool = False) 
             f"[qfedx_tpu] model={model.name} clients={data['cx'].shape[0]} "
             f"samples/client≤{data['cx'].shape[1]} classes={data['num_classes']}"
         )
-        result = train_federated(
-            model,
-            cfg.fed,
-            data["cx"],
-            data["cy"],
-            data["cmask"],
-            eval_x,
-            eval_y,
-            num_rounds=cfg.num_rounds,
-            seed=cfg.seed,
-            eval_every=cfg.eval_every,
-            on_round_end=lambda r, m: (
-                run.on_round_end(r, m),
-                print(f"[round {r + 1:3d}] " + json.dumps(m)) if (r + 1) % 5 == 0 else None,
-            )[0],
-            checkpointer=run.checkpointer(every=cfg.checkpoint_every),
+        import contextlib
+
+        profile_ctx = (
+            jax_profiler_trace(run.dir / "profile") if profile else contextlib.nullcontext()
         )
+        with profile_ctx:
+            result = train_federated(
+                model,
+                cfg.fed,
+                data["cx"],
+                data["cy"],
+                data["cmask"],
+                eval_x,
+                eval_y,
+                num_rounds=cfg.num_rounds,
+                seed=cfg.seed,
+                eval_every=cfg.eval_every,
+                on_round_end=lambda r, m: (
+                    run.on_round_end(r, m),
+                    print(f"[round {r + 1:3d}] " + json.dumps(m)) if (r + 1) % 5 == 0 else None,
+                )[0],
+                checkpointer=run.checkpointer(every=cfg.checkpoint_every),
+            )
         test_metrics = make_evaluator(model)(result.params, test_x, test_y)
         summary = {
             "final_accuracy": test_metrics["accuracy"],
@@ -198,11 +220,24 @@ def run_train(cfg: ExperimentConfig, resume: bool = False, plots: bool = False) 
         return summary
 
 
+def jax_profiler_trace(log_dir):
+    """jax.profiler.trace context (TensorBoard-loadable trace of the rounds
+    — the wall-clock observability the reference roadmap wants tracked,
+    ROADMAP.md:114)."""
+    import jax
+
+    return jax.profiler.trace(str(log_dir))
+
+
 def main(argv=None):
     args = build_parser().parse_args(argv)
     if args.cmd == "train":
         cfg = config_from_args(args)
-        run_train(cfg, resume=args.resume, plots=args.plots)
+        run_train(cfg, resume=args.resume, plots=args.plots, profile=args.profile)
+    elif args.cmd == "demo":
+        from qfedx_tpu.run.demo import run_demo
+
+        run_demo(out_dir=args.out, dataset=args.dataset)
 
 
 if __name__ == "__main__":

@@ -45,6 +45,7 @@ class ModelConfig:
     amp_damping_gamma: float = 0.0
     readout_flip: float = 0.0
     shots: int | None = None
+    noise_placement: str = "readout"  # "readout" (analytic) | "circuit" (trajectory)
 
 
 @dataclass(frozen=True)
@@ -102,6 +103,7 @@ def build_model(cfg: ExperimentConfig, num_classes: int):
                 readout_e01=m.readout_flip,
                 readout_e10=m.readout_flip,
                 shots=m.shots,
+                circuit_level=(m.noise_placement == "circuit"),
             )
         return make_vqc_classifier(
             n_qubits=m.n_qubits,

@@ -1,0 +1,29 @@
+"""Encoder demo entry point and preprocessed-data caching."""
+
+import numpy as np
+
+from qfedx_tpu.data.pipeline import Preprocessed
+from qfedx_tpu.run.demo import run_demo
+
+
+def test_run_demo(tmp_path):
+    out = run_demo(out_dir=str(tmp_path), dataset="mnist")
+    assert abs(out["amp_norm"] - 1.0) < 1e-5  # encoded state is normalized
+    assert len(out["z"]) == 4 and all(-1 <= z <= 1 for z in out["z"])
+    assert (tmp_path / "encoding_demo.png").stat().st_size > 0
+
+
+def test_preprocessed_save_load_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    prep = Preprocessed(
+        train=(rng.normal(size=(10, 4)).astype(np.float32), np.arange(10, dtype=np.int32) % 2),
+        val=(rng.normal(size=(3, 4)).astype(np.float32), np.zeros(3, dtype=np.int32)),
+        test=(rng.normal(size=(5, 4)).astype(np.float32), np.ones(5, dtype=np.int32)),
+        num_classes=2,
+    )
+    path = tmp_path / "data.npz"
+    prep.save(path)
+    loaded = Preprocessed.load(path)
+    assert loaded.num_classes == 2
+    np.testing.assert_array_equal(loaded.train[0], prep.train[0])
+    np.testing.assert_array_equal(loaded.test[1], prep.test[1])
