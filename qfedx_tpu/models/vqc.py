@@ -81,13 +81,24 @@ def make_vqc_classifier(
             return noise_model.noisy_logits(state, params["readout"], key)
         return z_logits(state, params["readout"])
 
+    circuit_noise = (
+        noise_model is not None
+        and noise_model.circuit_level
+        and len(noise_model.kraus_channels()) > 0
+    )
+
     # Finite-shot sampling needs a PRNG key, which the deterministic
     # ``apply`` contract doesn't carry: evaluation uses the exact
     # expectation (infinite-shot limit), training (``apply_train``) samples
-    # real shot noise from per-sample key streams.
-    eval_noise = (
-        noise_model.exact_shots() if noise_model is not None else None
-    )
+    # real shot noise from per-sample key streams. Under circuit-level
+    # noise the trained channel acts once per ansatz layer, so eval uses
+    # the layer-composed analytic strengths (NoiseModel.composed) to track
+    # the trained noise level instead of a single readout application.
+    eval_noise = None
+    if noise_model is not None:
+        eval_noise = noise_model.exact_shots()
+        if circuit_noise:
+            eval_noise = eval_noise.composed(n_layers)
 
     def apply(params, x):
         def one(xi):
@@ -98,11 +109,6 @@ def make_vqc_classifier(
 
         return jax.vmap(one)(x)
 
-    circuit_noise = (
-        noise_model is not None
-        and noise_model.circuit_level
-        and len(noise_model.kraus_channels()) > 0
-    )
     if circuit_noise and encoding == "reupload":
         raise ValueError("circuit-level noise supports angle/amplitude encodings")
 

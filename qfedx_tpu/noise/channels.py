@@ -21,7 +21,7 @@ the analytic maps against trajectory averages.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
 import jax
 import jax.numpy as jnp
@@ -35,8 +35,13 @@ from qfedx_tpu.ops.statevector import expect_z_all
 
 
 def depolarizing_kraus(p: float) -> CArray:
-    """{√(1−p)·I, √(p/3)·X, √(p/3)·Y, √(p/3)·Z}."""
-    s0, s1 = np.sqrt(1.0 - p), np.sqrt(p / 3.0)
+    """{√(1−3p/4)·I, √(p/4)·X, √(p/4)·Y, √(p/4)·Z}.
+
+    Convention: ρ → (1−p)ρ + p·I/2, i.e. ⟨Z⟩ → (1−p)⟨Z⟩ — the SAME p as the
+    analytic readout map in ``NoiseModel.apply_to_z``, so circuit-level
+    trajectories and readout-level analytics agree for equal strength.
+    """
+    s0, s1 = np.sqrt(1.0 - 3.0 * p / 4.0), np.sqrt(p / 4.0)
     ops = np.stack(
         [
             s0 * np.eye(2),
@@ -109,8 +114,40 @@ class NoiseModel:
     # as sampled Kraus trajectories after every ansatz layer
     # (noise.trajectory) instead of as analytic readout maps — the
     # reference roadmap's "insert noise ops in circuits" placement
-    # (ROADMAP.md:66). Evaluation stays analytic (exact channel average).
+    # (ROADMAP.md:66). Evaluation stays analytic but uses the
+    # layer-composed strengths (``composed(n_layers)``) so eval
+    # approximates the channel the model was trained under. The analytic
+    # composition is exact only when the channels commute with the
+    # interleaved entangling layers (true for global depolarizing, an
+    # approximation for per-qubit channels) — eval under circuit-level
+    # noise is a close stand-in, not the exact trajectory average.
     circuit_level: bool = False
+
+    def composed(self, n: int) -> "NoiseModel":
+        """Analytic strengths after ``n`` sequential applications.
+
+        One application is the affine ⟨Z⟩ map T(z) = a·z + γ with
+        a = (1−γ)(1−p) (depolarizing then damping, the ``apply_to_z``
+        order). Tⁿ is again affine — slope aⁿ, offset γ·(1−aⁿ)/(1−a) — and
+        any such map is realized by an effective (p_eff, γ_eff) pair, so
+        the composition is EXACT even with both channels on (the two maps
+        do not commute; composing each channel with itself separately
+        would be biased at O(p·γ)). Readout confusion and shots act once
+        at measurement and are left unchanged.
+        """
+        if n <= 1:
+            return self
+        p, g = self.depolarizing_p, self.amp_damping_gamma
+        a = (1.0 - g) * (1.0 - p)
+        slope = a**n
+        offset = 0.0 if g == 0.0 else g * (1.0 - slope) / (1.0 - a)
+        gamma_eff = offset
+        if gamma_eff >= 1.0:  # fully damped: z → 1 regardless of input
+            p_eff, gamma_eff = 0.0, 1.0
+        else:
+            # slope = (1−γ_eff)(1−p_eff) ⇒ solve for p_eff; clamp float dust.
+            p_eff = max(0.0, 1.0 - slope / (1.0 - gamma_eff))
+        return replace(self, depolarizing_p=p_eff, amp_damping_gamma=gamma_eff)
 
     def kraus_channels(self) -> list:
         """Stacked Kraus sets for the circuit-level channels that are on."""

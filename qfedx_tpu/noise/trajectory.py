@@ -11,9 +11,15 @@ trajectory instead of O(4^n) for the exact density matrix):
 Everything is traced: the Kraus branch is *sampled* with
 ``jax.random.categorical`` and *selected* with ``jnp.take`` over the
 stacked candidate states — no data-dependent Python control flow, so
-trajectories jit, vmap over keys, and differentiate (the estimator is the
-score-free reparameterized average; gradients flow through the selected
-branch).
+trajectories jit and vmap over keys. Loss *values* averaged over
+trajectories are unbiased estimates of the density-matrix expectation.
+
+Gradient caveat: categorical branch sampling is not reparameterizable —
+``jax.grad`` through ``jnp.take`` differentiates only the selected branch
+and drops the score-function term (the dependence of branch probabilities
+on parameters), so trajectory gradients are *biased*. For unbiased
+optimization under circuit noise use the SPSA estimator in
+``fed.client.make_spsa_grad`` (finite differences of unbiased loss values).
 """
 
 from __future__ import annotations

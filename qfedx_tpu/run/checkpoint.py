@@ -21,6 +21,8 @@ from typing import Any
 import jax
 import numpy as np
 
+from qfedx_tpu.utils.host import is_primary
+
 
 def _flatten(params: Any):
     leaves, treedef = jax.tree_util.tree_flatten(params)
@@ -42,16 +44,21 @@ class Checkpointer:
         if every < 1:
             raise ValueError("every must be ≥ 1")
         self.dir = Path(directory)
-        self.dir.mkdir(parents=True, exist_ok=True)
+        if is_primary():  # non-primary processes never write (see save())
+            self.dir.mkdir(parents=True, exist_ok=True)
         self.every = every
         self.keep = keep
 
     # -- save ----------------------------------------------------------------
 
     def save(self, round_idx: int, params: Any) -> Path:
+        path = self.dir / f"ckpt_{round_idx:06d}.npz"
+        if not is_primary():
+            # SPMD params are replicated; only process 0 writes (all
+            # processes saving the same file to shared storage would race).
+            return path
         leaves, _ = _flatten(params)
         host_leaves = [np.asarray(x) for x in leaves]
-        path = self.dir / f"ckpt_{round_idx:06d}.npz"
         tmp = path.with_suffix(".npz.tmp")
         with open(tmp, "wb") as f:
             np.savez(f, *host_leaves)
@@ -80,6 +87,8 @@ class Checkpointer:
     # -- restore -------------------------------------------------------------
 
     def _rounds(self) -> list[int]:
+        if not self.dir.exists():  # non-primary before shared storage syncs
+            return []
         out = []
         for p in self.dir.iterdir():
             m = self._PAT.search(p.name)
@@ -88,24 +97,52 @@ class Checkpointer:
         return out
 
     def latest_round(self) -> int | None:
-        rounds = self._rounds()
-        return max(rounds) if rounds else None
+        """Newest checkpointed round — a POD-WIDE decision.
+
+        Every process calls this on resume (trainer.py), and they must all
+        agree on the answer: if each host scanned its own disk, a host
+        whose view of shared storage lags (or that has no shared storage)
+        would resume at a different round with different params, and the
+        SPMD round's collectives would deadlock. Process 0 scans; the
+        result is broadcast.
+        """
+        rounds = self._rounds() if is_primary() else []
+        r = max(rounds) if rounds else -1
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            r = int(multihost_utils.broadcast_one_to_all(np.int32(r)))
+        return None if r < 0 else r
 
     def restore(self, round_idx: int, template: Any) -> Any:
-        """Load round ``round_idx`` into the structure of ``template``."""
-        path = self.dir / f"ckpt_{round_idx:06d}.npz"
+        """Load round ``round_idx`` into the structure of ``template``.
+
+        Multi-host: only process 0 reads the files (storage may not be
+        shared or may lag); leaves are broadcast to every process, so all
+        hosts restore bit-identical params.
+        """
         leaves, treedef = _flatten(template)
-        with np.load(path) as data:
-            loaded = [data[f"arr_{i}"] for i in range(len(data.files))]
-        if len(loaded) != len(leaves):
-            raise ValueError(
-                f"checkpoint has {len(loaded)} leaves, template has {len(leaves)}"
-            )
-        for i, (got, want) in enumerate(zip(loaded, leaves)):
-            if got.shape != np.shape(want):
+        if is_primary():
+            path = self.dir / f"ckpt_{round_idx:06d}.npz"
+            with np.load(path) as data:
+                loaded = [data[f"arr_{i}"] for i in range(len(data.files))]
+            if len(loaded) != len(leaves):
                 raise ValueError(
-                    f"leaf {i}: checkpoint shape {got.shape} != model {np.shape(want)}"
+                    f"checkpoint has {len(loaded)} leaves, template has {len(leaves)}"
                 )
+            for i, (got, want) in enumerate(zip(loaded, leaves)):
+                if got.shape != np.shape(want):
+                    raise ValueError(
+                        f"leaf {i}: checkpoint shape {got.shape} != model {np.shape(want)}"
+                    )
+        else:
+            loaded = [
+                np.zeros(np.shape(x), dtype=np.asarray(x).dtype) for x in leaves
+            ]
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            loaded = multihost_utils.broadcast_one_to_all(loaded)
         return jax.tree_util.tree_unflatten(
             treedef, [jax.numpy.asarray(x) for x in loaded]
         )

@@ -151,6 +151,11 @@ def run_train(
     from qfedx_tpu.fed.evaluate import make_evaluator
     from qfedx_tpu.run.metrics import ExperimentRun
     from qfedx_tpu.run.trainer import train_federated
+    from qfedx_tpu.utils.host import is_primary
+
+    # Multi-host: progress lines from every process interleave on shared
+    # consoles; only process 0 speaks (artifacts are gated inside run/).
+    say = print if is_primary() else (lambda *a, **k: None)
 
     data = build_data(cfg)
     model = build_model(cfg, data["num_classes"])
@@ -162,8 +167,8 @@ def run_train(
     eval_x, eval_y = (val_x, val_y) if have_val else (test_x, test_y)
 
     with ExperimentRun(cfg.run_root, cfg.run_name(), config=cfg, resume=resume) as run:
-        print(f"[qfedx_tpu] run dir: {run.dir}")
-        if plots:
+        say(f"[qfedx_tpu] run dir: {run.dir}")
+        if plots and is_primary():
             # Reference-parity data inspection artifacts
             # (src/CFed/Preprocess.py:71-134 saves the same two PNGs).
             from qfedx_tpu.data.viz import (
@@ -174,7 +179,7 @@ def run_train(
             tr_x, _ = data["train"]
             save_client_samples(tr_x, data["parts"], run.dir / "client_samples.png")
             save_class_distribution(data["stats"], run.dir / "class_distribution.png")
-        print(
+        say(
             f"[qfedx_tpu] model={model.name} clients={data['cx'].shape[0]} "
             f"samples/client≤{data['cx'].shape[1]} classes={data['num_classes']}"
         )
@@ -197,7 +202,7 @@ def run_train(
                 eval_every=cfg.eval_every,
                 on_round_end=lambda r, m: (
                     run.on_round_end(r, m),
-                    print(f"[round {r + 1:3d}] " + json.dumps(m)) if (r + 1) % 5 == 0 else None,
+                    say(f"[round {r + 1:3d}] " + json.dumps(m)) if (r + 1) % 5 == 0 else None,
                 )[0],
                 checkpointer=run.checkpointer(every=cfg.checkpoint_every),
             )
@@ -216,7 +221,7 @@ def run_train(
             "final_epsilon": result.epsilons[-1] if result.epsilons else None,
         }
         run.finish(**summary)
-        print("[qfedx_tpu] " + json.dumps(summary))
+        say("[qfedx_tpu] " + json.dumps(summary))
         return summary
 
 

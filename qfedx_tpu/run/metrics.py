@@ -18,6 +18,8 @@ import time
 from pathlib import Path
 from typing import Any, Mapping
 
+from qfedx_tpu.utils.host import is_primary
+
 
 def _jsonable(x: Any) -> Any:
     if dataclasses.is_dataclass(x) and not isinstance(x, type):
@@ -33,22 +35,68 @@ def _jsonable(x: Any) -> Any:
     return x
 
 
+def _agreed_run_dir_name(root: Path, name: str, resume: bool) -> str:
+    """Run-dir name every process agrees on.
+
+    Name collisions are resolved by appending a timestamp — but the
+    collision check and the stamp must be decided by ONE process: each
+    process deciding locally races the primary's mkdir and drifts across
+    second boundaries/clock skew, leaving hosts writing to different dirs
+    (and, worse, a non-primary resuming checkpoints from the OLD colliding
+    dir while the primary starts fresh in the stamped one). Process 0
+    decides; the decision is broadcast as (collide?, unix seconds).
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        if (root / name).exists() and not resume:
+            return f"{name}-{time.strftime('%Y%m%d-%H%M%S')}"
+        return name
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    decision = np.zeros((2,), np.uint32)
+    if is_primary():
+        collide = (root / name).exists() and not resume
+        decision = np.asarray(
+            [1 if collide else 0, int(time.time()) if collide else 0], np.uint32
+        )
+    decision = np.asarray(multihost_utils.broadcast_one_to_all(decision))
+    if int(decision[0]):
+        # gmtime, not localtime: hosts in different timezones must format
+        # the broadcast seconds to the same string.
+        stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime(int(decision[1])))
+        return f"{name}-{stamp}"
+    return name
+
+
 class MetricsLogger:
-    """Append-only JSONL metrics stream; flushed per record (crash-safe)."""
+    """Append-only JSONL metrics stream; flushed per record (crash-safe).
+
+    On multi-host pods only process 0 writes (every process appending the
+    same records to shared storage duplicates lines); other processes get a
+    no-op logger with the same interface.
+    """
 
     def __init__(self, path: str | Path):
         self.path = Path(path)
-        self.path.parent.mkdir(parents=True, exist_ok=True)
-        self._fh = open(self.path, "a")
+        self._fh = None
+        if is_primary():
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._fh = open(self.path, "a")
 
     def log(self, record: Mapping[str, Any]) -> None:
+        if self._fh is None:
+            return
         rec = dict(_jsonable(record))
         rec.setdefault("ts", time.time())
         self._fh.write(json.dumps(rec) + "\n")
         self._fh.flush()
 
     def close(self) -> None:
-        self._fh.close()
+        if self._fh is not None:
+            self._fh.close()
 
     def __enter__(self):
         return self
@@ -71,15 +119,13 @@ class ExperimentRun:
     def __init__(
         self, root: str | Path, name: str, config: Any = None, resume: bool = False
     ):
-        self.dir = Path(root) / name
-        if self.dir.exists() and not resume:
-            stamp = time.strftime("%Y%m%d-%H%M%S")
-            self.dir = Path(root) / f"{name}-{stamp}"
-        self.dir.mkdir(parents=True, exist_ok=True)
-        if config is not None:
-            (self.dir / "config.json").write_text(
-                json.dumps(_jsonable(config), indent=2)
-            )
+        self.dir = Path(root) / _agreed_run_dir_name(Path(root), name, resume)
+        if is_primary():
+            self.dir.mkdir(parents=True, exist_ok=True)
+            if config is not None:
+                (self.dir / "config.json").write_text(
+                    json.dumps(_jsonable(config), indent=2)
+                )
         self.metrics = MetricsLogger(self.dir / "metrics.jsonl")
         self._t0 = time.time()
 
@@ -93,10 +139,13 @@ class ExperimentRun:
 
     def log_artifact(self, name: str, obj: Any) -> Path:
         path = self.dir / name
-        path.write_text(json.dumps(_jsonable(obj), indent=2))
+        if is_primary():
+            path.write_text(json.dumps(_jsonable(obj), indent=2))
         return path
 
     def finish(self, **summary: Any) -> None:
+        if not is_primary():
+            return
         summary = dict(summary)
         summary["wall_time_s"] = time.time() - self._t0
         (self.dir / "summary.json").write_text(json.dumps(_jsonable(summary), indent=2))

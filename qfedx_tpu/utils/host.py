@@ -1,0 +1,19 @@
+"""Multi-host process-role helpers.
+
+``parallel.mesh`` targets multi-host TPU pods, where every host runs the
+same program (SPMD). Host-side artifacts — checkpoints, metrics JSONL,
+summary files, progress prints — must be written by exactly one process or
+concurrent writes to shared storage corrupt/duplicate them (the reference
+is single-process and never faces this; src/CFed/Classical_FL.py prints
+freely). Everything in ``run/`` that touches disk or stdout gates on
+``is_primary()``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def is_primary() -> bool:
+    """True on the process that owns host-side IO (process 0)."""
+    return jax.process_index() == 0

@@ -65,6 +65,26 @@ def test_noise_strength_monotone():
     assert vals == sorted(vals, reverse=True)
 
 
+def test_composed_matches_sequential_application():
+    """composed(n) ≡ applying the channel's ⟨Z⟩ map n times — including
+    both channels on at once (the maps don't commute; the composition must
+    track the interleaved order, not compose each channel separately)."""
+    z = jnp.asarray([0.7, -0.3])
+    for nm in (
+        NoiseModel(depolarizing_p=0.15),
+        NoiseModel(amp_damping_gamma=0.2),
+        NoiseModel(depolarizing_p=0.3, amp_damping_gamma=0.3),
+        NoiseModel(depolarizing_p=0.1, amp_damping_gamma=1.0),
+    ):
+        seq = z
+        for _ in range(3):
+            seq = nm.apply_to_z(seq, None)
+        np.testing.assert_allclose(
+            nm.composed(3).apply_to_z(z, None), seq, atol=1e-6
+        )
+    assert NoiseModel(depolarizing_p=0.1).composed(1) == NoiseModel(depolarizing_p=0.1)
+
+
 def test_finite_shots_unbiased_and_noisy():
     z = jnp.asarray([0.4] * 64)
     nm = NoiseModel(shots=256)
@@ -88,6 +108,27 @@ def test_trajectory_preserves_norm():
     np.testing.assert_allclose(float(jnp.sum(sv.cabs2(out))), 1.0, atol=1e-5)
 
 
+def test_depolarizing_kraus_exact_channel_matches_analytic():
+    """Σ_k ⟨ψ|K_k†ZK_k|ψ⟩ = (1−p)⟨Z⟩ — deterministic convention check.
+
+    expect_z is the plain quadratic form (no renormalization), so summing
+    it over unnormalized Kraus branches IS the exact channel average. This
+    pins the Kraus convention {√(1−3p/4)I, √(p/4)X/Y/Z} to the analytic
+    readout map ⟨Z⟩→(1−p)⟨Z⟩ with no Monte-Carlo slack.
+    """
+    from qfedx_tpu.noise.trajectory import _kraus_op
+
+    n, p, qubit = 3, 0.4, 1
+    state = random_state(n, seed=2)
+    z_clean = float(sv.expect_z(state, qubit))
+    kraus = depolarizing_kraus(p)
+    z_exact = sum(
+        float(sv.expect_z(sv.apply_gate(state, _kraus_op(kraus, i), qubit), qubit))
+        for i in range(kraus.re.shape[0])
+    )
+    np.testing.assert_allclose(z_exact, (1.0 - p) * z_clean, atol=1e-6)
+
+
 def test_trajectory_depolarizing_matches_analytic():
     """E_traj[⟨Z⟩] = (1−p)·⟨Z⟩ for the depolarizing channel."""
     n, p, qubit = 3, 0.4, 1
@@ -98,10 +139,10 @@ def test_trajectory_depolarizing_matches_analytic():
         lambda key: sv.expect_z(
             apply_channel(state, depolarizing_kraus(p), qubit, key), qubit
         ),
-        n_trajectories=3000,
+        n_trajectories=8000,
     )
     z_noisy = float(est(jax.random.PRNGKey(3)))
-    np.testing.assert_allclose(z_noisy, (1.0 - p) * z_clean, atol=0.05)
+    np.testing.assert_allclose(z_noisy, (1.0 - p) * z_clean, atol=0.025)
 
 
 def test_trajectory_damping_matches_analytic():
