@@ -1,0 +1,109 @@
+"""Measure the Pallas gate kernel vs the default XLA path on real TPU.
+
+Decides the routing threshold in ops/statevector.py:apply_gate from data
+(round-1 VERDICT: the ≥2^14 cutoff was asserted, never measured). For each
+qubit count n, times a batch of single-qubit gate applications on a fully
+complex state through both paths and reports the ratio; run on the real
+chip, results are committed to benchmarks/pallas_sweep.json and the
+threshold constant updated to match.
+
+Usage (from the repo root, on the TPU):
+    python benchmarks/pallas_sweep.py [--min 10] [--max 22] [--reps 9]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+
+def time_gate_chain(jax, n_qubits: int, use_pallas: bool, reps: int) -> float:
+    """Median seconds for a jitted chain of 2n complex 1q gates (every
+    qubit touched twice — enough work per dispatch to time reliably)."""
+    import jax.numpy as jnp
+
+    from qfedx_tpu.ops import gates
+    from qfedx_tpu.ops.cpx import CArray
+    from qfedx_tpu.ops.statevector import apply_gate
+
+    os.environ["QFEDX_PALLAS"] = "1" if use_pallas else "0"
+
+    rng = np.random.default_rng(0)
+    shape = (2,) * n_qubits
+    re = rng.normal(size=shape).astype(np.float32)
+    im = rng.normal(size=shape).astype(np.float32)
+    nrm = np.sqrt((re**2 + im**2).sum())
+    state = CArray(jnp.asarray(re / nrm), jnp.asarray(im / nrm))
+    gate = gates.rot_zx(jnp.float32(0.3), jnp.float32(0.7))  # complex 2x2
+
+    @jax.jit
+    def chain(s: CArray) -> CArray:
+        for q in range(n_qubits):
+            s = apply_gate(s, gate, q)
+        for q in reversed(range(n_qubits)):
+            s = apply_gate(s, gate, q)
+        return s
+
+    out = chain(state)  # compile (env read at trace time)
+    jax.block_until_ready(out.re)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = chain(state)
+        jax.block_until_ready(out.re)
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--min", type=int, default=10)
+    ap.add_argument("--max", type=int, default=22)
+    ap.add_argument("--reps", type=int, default=9)
+    args = ap.parse_args()
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    rows = []
+    for n in range(args.min, args.max + 1):
+        xla_s = time_gate_chain(jax, n, use_pallas=False, reps=args.reps)
+        try:
+            pl_s = time_gate_chain(jax, n, use_pallas=True, reps=args.reps)
+            err = None
+        except Exception as e:  # noqa: BLE001
+            pl_s, err = None, f"{type(e).__name__}: {e}"
+        row = {
+            "n_qubits": n,
+            "gates": 2 * n,
+            "xla_s": round(xla_s, 6),
+            "pallas_s": round(pl_s, 6) if pl_s else None,
+            "pallas_speedup": round(xla_s / pl_s, 3) if pl_s else None,
+            "error": err,
+        }
+        rows.append(row)
+        print(json.dumps(row))
+
+    wins = [r["n_qubits"] for r in rows if (r["pallas_speedup"] or 0) > 1.05]
+    out = {
+        "platform": platform,
+        "reps": args.reps,
+        "rows": rows,
+        "pallas_wins_at": wins,
+        "recommended_threshold": min(wins) if wins else None,
+    }
+    path = Path(__file__).parent / "pallas_sweep.json"
+    path.write_text(json.dumps(out, indent=2))
+    print(f"wrote {path}: pallas wins at n ∈ {wins or 'nowhere'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -40,6 +40,12 @@ class FedConfig:
     dp: DPConfig | None = None
     secure_agg: bool = False
     secure_agg_scale: float = 1.0  # std of pairwise masks (ROADMAP.md:52-55)
+    # Pair graph: "ring" = k-successor ring among the round's cohort, O(k)
+    # PRG samples per client (scales to the 256-client BASELINE configs);
+    # "pairwise" = complete graph, O(C) per client, collusion threshold
+    # C−1 (the roadmap's literal construction).
+    secure_agg_mode: str = "ring"
+    secure_agg_neighbors: int = 1  # ring hops k; unmasking needs 2k colluders
     # Under DP, clients are weighted uniformly (sample-count weights would
     # leak dataset sizes through the sensitivity analysis).
     dp_uniform_weights: bool = True
@@ -51,3 +57,7 @@ class FedConfig:
             raise ValueError(f"unknown optimizer {self.optimizer!r}")
         if self.algorithm == "fedprox" and self.prox_mu <= 0:
             raise ValueError("fedprox requires prox_mu > 0")
+        if self.secure_agg_mode not in ("ring", "pairwise"):
+            raise ValueError(f"unknown secure_agg_mode {self.secure_agg_mode!r}")
+        if self.secure_agg_neighbors < 1:
+            raise ValueError("secure_agg_neighbors must be ≥ 1")

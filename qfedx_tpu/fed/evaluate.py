@@ -15,17 +15,36 @@ import numpy as np
 from qfedx_tpu.models.api import Model
 
 
-def make_evaluator(model: Model, batch_size: int = 256):
+def make_evaluator(model: Model, batch_size: int = 256, apply_fn=None,
+                   max_batches: int | None = None):
     """Return ``evaluate(params, x, y) -> dict`` computing accuracy and
-    (for binary problems) one-vs-rest AUC on host from device logits."""
+    (for binary problems) one-vs-rest AUC on host from device logits.
+
+    ``apply_fn`` overrides ``model.apply`` — required for sv-sharded models
+    (``model.sv_size > 1``), whose apply contains collectives and is only
+    host-callable wrapped in a shard_map (``models.vqc_sharded.host_apply``).
+    ``max_batches`` caps per-call work (large eval sets would otherwise
+    serialize and dominate round time at scale): metrics come from the
+    first ``max_batches·batch_size`` examples and ``n`` reports the subset.
+    """
+    if apply_fn is None and model.sv_size > 1:
+        raise ValueError(
+            f"model {model.name} is sv-sharded; pass apply_fn="
+            "host_apply(model, mesh) (its bare apply has sv collectives "
+            "that cannot be jitted outside a shard_map)"
+        )
+    fwd = apply_fn if apply_fn is not None else model.apply
 
     @jax.jit
     def batch_logits(params, xb):
-        return model.apply(params, xb)
+        return fwd(params, xb)
 
     def evaluate(params, x, y):
         x = np.asarray(x, dtype=np.float32)
         y = np.asarray(y)
+        if max_batches is not None and len(x) > max_batches * batch_size:
+            x = x[: max_batches * batch_size]
+            y = y[: max_batches * batch_size]
         n = len(x)
         pad = (-n) % batch_size
         if pad:

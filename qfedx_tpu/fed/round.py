@@ -35,7 +35,7 @@ from qfedx_tpu.fed.client import make_local_update
 from qfedx_tpu.fed.config import FedConfig
 from qfedx_tpu.fed.privacy import privatize
 from qfedx_tpu.fed.sampling import participation_mask
-from qfedx_tpu.fed.secure_agg import client_mask
+from qfedx_tpu.fed.secure_agg import client_mask, ring_mask
 from qfedx_tpu.models.api import Model
 from qfedx_tpu.utils import trees
 
@@ -89,9 +89,15 @@ def make_fed_round(
             weight = weight * part[cid]
             contrib = trees.tree_scale(delta, weight)
             if cfg.secure_agg:
-                mask = client_mask(
-                    sa_key, cid, num_clients, delta, part, cfg.secure_agg_scale
-                )
+                if cfg.secure_agg_mode == "ring":
+                    mask = ring_mask(
+                        sa_key, cid, num_clients, delta, part,
+                        cfg.secure_agg_scale, cfg.secure_agg_neighbors,
+                    )
+                else:
+                    mask = client_mask(
+                        sa_key, cid, num_clients, delta, part, cfg.secure_agg_scale
+                    )
                 contrib = trees.tree_add(contrib, mask)
             return contrib, weight, loss
 

@@ -1,23 +1,34 @@
 """Secure aggregation via pairwise antisymmetric PRG masks, on-device.
 
-Reference spec (ROADMAP.md:52-55,137-138): for each client pair i<j generate
-a mask m_ij; client i adds +m_ij, client j adds −m_ij, so the server-side
-sum of masked updates equals the sum of raw updates while no individual
-update is ever visible in the clear.
+Reference spec (ROADMAP.md:52-55,137-138): for each masked client pair
+(i, j) generate a mask m_ij; client i adds +m_ij, client j adds −m_ij, so
+the server-side sum of masked updates equals the sum of raw updates while
+no individual update is ever visible in the clear.
 
 TPU-native construction (BASELINE.json north star: "secure-aggregation
 masks move to jax.random on-device"): the pair key is a deterministic fold
-of a shared round key with (min(i,j), max(i,j)) — the SPMD analog of the
+of a shared round key with the pair's ids — the SPMD analog of the
 roadmap's simulated DH seed exchange at registration; every device can
-derive its pair keys locally with zero communication. Masks are sampled
-leaf-by-leaf with ``trees.tree_random_normal``, accumulated over peers with
-``lax.scan`` so memory stays O(|θ|) regardless of cohort size.
+derive its pair keys locally with zero communication.
 
-Client-sampling interaction: a pair's masks must cancel, so pair (i, j)
-is masked only when *both* are in the round's cohort. Cohort membership is
-derived from the replicated round key (``fed.sampling``), so every client
-computes every peer's membership locally — the jit-friendly stand-in for
-the real protocol's mask-recovery phase (SURVEY.md §7.3.3).
+Two pair graphs, both with exact cancellation under the cohort-wide sum:
+
+- ``ring_mask`` (the default): each participant pairs with its ``k``
+  cyclic successors in the sorted order of this round's cohort. O(k) PRG
+  tree-samples per client — scales to the 256-client BASELINE configs
+  where the complete graph's O(C) samples per client (O(C²) per round)
+  does not. Unmasking one client requires its 2k ring neighbors to
+  collude with the server; raise ``neighbors`` to harden.
+- ``client_mask``: the complete pair graph (every pair masked, collusion
+  threshold C−1) — the reference roadmap's construction verbatim; use for
+  small cohorts or as the correctness oracle.
+
+Client-sampling interaction: a pair's masks must cancel, so pairs are
+drawn among this round's cohort only. Cohort membership is derived from
+the replicated round key (``fed.sampling``), so every client computes
+every peer's membership — and its ring neighbors — locally, the
+jit-friendly stand-in for the real protocol's mask-recovery phase
+(SURVEY.md §7.3.3).
 """
 
 from __future__ import annotations
@@ -63,3 +74,61 @@ def client_mask(
 
     masked, _ = jax.lax.scan(body, zeros, jnp.arange(num_clients))
     return masked
+
+
+def _edge_key(base_key: jax.Array, src, dst, d: int) -> jax.Array:
+    """Key for the directed ring edge src → dst at hop distance d.
+
+    Direction is defined by ring order, so no (min, max) symmetrization:
+    the source adds +PRG(edge), the destination subtracts the same PRG.
+    """
+    return jax.random.fold_in(
+        jax.random.fold_in(jax.random.fold_in(base_key, src), dst), d
+    )
+
+
+def ring_mask(
+    base_key: jax.Array,
+    client_id,
+    num_clients: int,
+    template,
+    participation,  # [num_clients] 0/1 — cohort membership this round
+    scale: float = 1.0,
+    neighbors: int = 1,
+):
+    """O(neighbors) secure-agg mask: pair with the k cyclic successors
+    among this round's participants.
+
+    Cancellation: for each hop d, succ_d is a rotation (a bijection) on
+    the cohort ordered by client id, so every directed edge (i, succ_d(i))
+    appears exactly once with +PRG (at its source) and once with −PRG (at
+    its destination — which derives the same key via pred_d). Self-edges
+    (cohort smaller than the hop distance makes succ_d(i) = i) get
+    coefficient 0, so cohorts of size 0/1 degenerate to no masking — as
+    they must: there is no peer to hide behind.
+    """
+    part = participation.astype(jnp.float32)
+    parti = participation.astype(jnp.int32)
+    # Participants first (ascending id), non-participants after: stable
+    # order every client derives identically from the replicated cohort.
+    order = jnp.argsort((1 - parti) * (2 * num_clients) + jnp.arange(num_clients))
+    rank = jnp.cumsum(parti)[client_id] - 1  # my position among participants
+    n_part = jnp.maximum(jnp.sum(parti), 1)
+    my_part = part[client_id]
+
+    acc = trees.tree_zeros_like(template)
+    for d in range(1, neighbors + 1):
+        succ = order[jnp.mod(rank + d, n_part)]
+        pred = order[jnp.mod(rank - d, n_part)]
+        c_out = my_part * jnp.where(succ == client_id, 0.0, 1.0) * scale
+        c_in = my_part * jnp.where(pred == client_id, 0.0, 1.0) * scale
+        m_out = trees.tree_random_normal(
+            _edge_key(base_key, client_id, succ, d), template
+        )
+        m_in = trees.tree_random_normal(
+            _edge_key(base_key, pred, client_id, d), template
+        )
+        acc = jax.tree.map(
+            lambda a, mo, mi: a + c_out * mo - c_in * mi, acc, m_out, m_in
+        )
+    return acc

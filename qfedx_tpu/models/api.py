@@ -41,3 +41,10 @@ class Model:
     # Optional stochastic forward for local training (e.g. dropout):
     # (params, x, key) -> logits. Falls back to ``apply`` when None.
     apply_train: Callable[[Params, jax.Array, jax.Array], jax.Array] | None = None
+    # Mesh requirements. A model whose ``apply`` contains collectives (the
+    # sv-sharded VQC) sets sv_size > 1: callers must trace it inside a
+    # shard_map over a mesh carrying ``sv_axis`` of that size (the trainer
+    # builds the (clients, sv) mesh and evaluates via host_apply from
+    # this). sv_size == 1 means plain host-callable apply.
+    sv_size: int = 1
+    sv_axis: str = "sv"

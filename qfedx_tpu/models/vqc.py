@@ -60,6 +60,19 @@ def make_vqc_classifier(
         raise ValueError(f"need n_qubits ≥ num_classes ({num_classes})")
     if encoding not in ("angle", "amplitude", "reupload"):
         raise ValueError(f"unknown encoding {encoding!r}")
+    if encoding == "angle" and basis == "rz":
+        import warnings
+
+        # RZ(θ)|0⟩ is a pure global phase: the encoded state carries NO
+        # feature information and the classifier cannot learn. The basis is
+        # kept for API parity with the reference (qAngle.py:45-50) but
+        # silently accepting it in a classifier is a footgun.
+        warnings.warn(
+            "basis='rz' angle encoding produces a global phase only — the "
+            "features are invisible to the circuit; use 'ry' or 'rx'",
+            UserWarning,
+            stacklevel=2,
+        )
 
     def init(key: jax.Array):
         k_ansatz, k_read = jax.random.split(key)

@@ -45,20 +45,43 @@ def make_sharded_vqc_classifier(
     num_classes: int = 2,
     sv_axis: str = "sv",
     init_scale: float = 0.1,
+    encoding: str = "angle",
+    noise_model=None,
 ) -> Model:
     """VQC Model whose forward runs on an ``sv_size``-way sharded state.
 
     ``sv_size`` must be a power of two with ≥2 local qubits left over.
     ``apply`` REQUIRES an enclosing shard_map carrying ``sv_axis``.
+    ``encoding``: "angle" (n features) or "amplitude" (2^n features).
+    ``noise_model``: optional ``noise.channels.NoiseModel``, same semantics
+    as the dense model (reference ROADMAP.md:64-73 at the ≥20-qubit
+    regime): analytic readout maps in ``apply``; with ``circuit_level``
+    and/or ``shots``, ``apply_train`` runs sampled Kraus trajectories /
+    shot noise keyed identically to the dense engine.
     """
     if num_classes > n_qubits:
         raise ValueError(f"need n_qubits ≥ num_classes ({num_classes})")
+    if encoding not in ("angle", "amplitude"):
+        raise ValueError(f"sharded VQC supports angle/amplitude, got {encoding!r}")
     n_global = (sv_size - 1).bit_length()
     if 1 << n_global != sv_size:
         raise ValueError(f"sv_size {sv_size} is not a power of two")
     if n_qubits - n_global < 2:
         raise ValueError("need ≥2 local qubits for sharded 2q gates")
     ctx = ShardCtx(axis=sv_axis, n_qubits=n_qubits, n_global=n_global)
+
+    circuit_noise = (
+        noise_model is not None
+        and noise_model.circuit_level
+        and len(noise_model.kraus_channels()) > 0
+    )
+    # Same eval convention as models.vqc: exact expectation (infinite
+    # shots); circuit-level channels eval with layer-composed strengths.
+    eval_noise = None
+    if noise_model is not None:
+        eval_noise = noise_model.exact_shots()
+        if circuit_noise:
+            eval_noise = eval_noise.composed(n_layers)
 
     def init(key: jax.Array):
         k_ansatz, k_read = jax.random.split(key)
@@ -67,9 +90,15 @@ def make_sharded_vqc_classifier(
             "readout": init_readout_params(k_read, num_classes),
         }
 
-    def apply_one(params, x):
-        state = sharded_hea_state(ctx, x, params["ansatz"])
+    def logits_one(params, x, nm, key, channels=(), traj_key=None):
+        state = sharded_hea_state(
+            ctx, x, params["ansatz"], encoding, channels, traj_key
+        )
         z = expect_z_all_sharded(ctx, state)[:num_classes]
+        if nm is not None:
+            # z is replicated after the psum; the analytic maps (and the
+            # replicated-key shot sampling) keep it replicated.
+            z = nm.apply_to_z(z, key)
         return params["readout"]["scale"] * z + params["readout"]["bias"]
 
     def apply(params, x):
@@ -77,7 +106,38 @@ def make_sharded_vqc_classifier(
         # per-device partial + psum-transpose scaling so parameter gradients
         # come out replicated and exact.
         params = jax.tree.map(lambda p: pmean_grad(p, sv_axis), params)
-        return jax.vmap(lambda xi: apply_one(params, xi))(x)
+        return jax.vmap(lambda xi: logits_one(params, xi, eval_noise, None))(x)
+
+    apply_train = None
+    if circuit_noise:
+        from dataclasses import replace as _dc_replace
+
+        # Channels already acted in-circuit; readout keeps confusion/shots.
+        readout_noise = _dc_replace(
+            noise_model, depolarizing_p=0.0, amp_damping_gamma=0.0
+        )
+        channels = tuple(noise_model.kraus_channels())
+
+        def apply_train(params, x, key):
+            params = jax.tree.map(lambda p: pmean_grad(p, sv_axis), params)
+            keys = jax.random.split(key, x.shape[0])
+
+            def one(xi, k):
+                k_traj, k_shot = jax.random.split(k)
+                return logits_one(
+                    params, xi, readout_noise, k_shot, channels, k_traj
+                )
+
+            return jax.vmap(one)(x, keys)
+
+    elif noise_model is not None and noise_model.shots is not None:
+
+        def apply_train(params, x, key):
+            params = jax.tree.map(lambda p: pmean_grad(p, sv_axis), params)
+            keys = jax.random.split(key, x.shape[0])
+            return jax.vmap(
+                lambda xi, k: logits_one(params, xi, noise_model, k)
+            )(x, keys)
 
     def wrap_delta(delta):
         return {
@@ -89,7 +149,10 @@ def make_sharded_vqc_classifier(
         init=init,
         apply=apply,
         wrap_delta=wrap_delta,
-        name=f"svqc{n_qubits}q{n_layers}l-sv{sv_size}",
+        apply_train=apply_train,
+        name=f"svqc{n_qubits}q{n_layers}l-{encoding}-sv{sv_size}",
+        sv_size=sv_size,
+        sv_axis=sv_axis,
     )
 
 

@@ -59,9 +59,14 @@ def _kernel(g_ref, x0r_ref, x1r_ref, x0i_ref, x1i_ref,
 
 
 def _tile(m: int, r: int) -> tuple[int, int]:
-    """(bm, br) powers of two dividing (m, r), ~512KB/tile budget."""
-    br = min(r, 4096)
-    bm = min(m, max(1, (1 << 17) // br))
+    """(bm, br) powers of two dividing (m, r), aligned to the TPU (8, 128)
+    f32 tile: br a multiple of 128 (callers guarantee r ≥ 128 — see
+    ``min_lane_qubits``), bm a multiple of 8 where m allows. Tile budget is
+    kept small (≤64KB/slab, 8 slabs ≈ 512KB) so the kernel stays far under
+    the 16MB scoped-vmem limit even when an outer vmap batches the call.
+    """
+    br = min(r, 512)
+    bm = min(m, max(8, (1 << 14) // br))
     return bm, br
 
 
@@ -137,3 +142,16 @@ def apply_gate_pallas(state: CArray, gate: CArray, qubit: int) -> CArray:
 
 def pallas_enabled() -> bool:
     return os.environ.get("QFEDX_PALLAS", "0") == "1"
+
+
+# Route to the kernel only when the pair-lane dim R = 2^(n-qubit-1) is at
+# least one full 128-lane vector register: smaller R makes every (bm, br)
+# block pad 128/R× under the TPU's (8, 128) f32 tiling, which is where the
+# measured vmem blowups at high qubit indices came from (the scoped-vmem
+# OOMs in BENCH_r02's first pallas run). High qubits fall back to the XLA
+# path, which handles the transposed-contraction case natively.
+MIN_LANE_QUBITS = 7  # need n - qubit - 1 ≥ 7, i.e. R ≥ 128
+
+
+def pallas_eligible(n_qubits: int, qubit: int) -> bool:
+    return n_qubits - qubit - 1 >= MIN_LANE_QUBITS

@@ -93,7 +93,9 @@ def apply_gate(state: CArray, gate: CArray, qubit: int) -> CArray:
     if state.ndim >= 14 and state.im is not None and gate.ndim == 2:
         from qfedx_tpu.ops import pallas_gates
 
-        if pallas_gates.pallas_enabled():
+        if pallas_gates.pallas_enabled() and pallas_gates.pallas_eligible(
+            state.ndim, qubit
+        ):
             return pallas_gates.apply_gate_pallas(state, gate, qubit)
     return _apply(gate, state, ((1,), (qubit,)), 0, qubit)
 

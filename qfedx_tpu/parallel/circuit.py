@@ -19,6 +19,8 @@ from qfedx_tpu.circuits.encoders import angle_amplitudes
 from qfedx_tpu.ops import gates
 from qfedx_tpu.parallel.sharded import (
     ShardCtx,
+    amplitude_encode_local,
+    apply_channel_all_sharded,
     apply_gate_2q_sharded,
     apply_gate_sharded,
     expect_z_all_sharded,
@@ -26,12 +28,33 @@ from qfedx_tpu.parallel.sharded import (
 )
 
 
-def sharded_hea_state(ctx: ShardCtx, features: jnp.ndarray, params: dict):
-    """Angle-encode ``features`` (shape (n,), in [0,1]) and run the
-    hardware-efficient ansatz, all on the sharded state. Mirrors
-    circuits.ansatz.hardware_efficient gate-for-gate."""
+def sharded_encoded_state(ctx: ShardCtx, features: jnp.ndarray, encoding: str):
+    """Encoder → local shard. angle: product state, zero communication
+    (circuits.encoders.angle_encode); amplitude: replicated feature slice
+    (parallel.sharded.amplitude_encode_local)."""
+    if encoding == "angle":
+        return product_state_local(ctx, angle_amplitudes(features * jnp.pi, "ry"))
+    if encoding == "amplitude":
+        return amplitude_encode_local(ctx, features)
+    raise ValueError(f"unknown sharded encoding {encoding!r}")
+
+
+def sharded_hea_state(
+    ctx: ShardCtx,
+    features: jnp.ndarray,
+    params: dict,
+    encoding: str = "angle",
+    channels: tuple = (),
+    key=None,
+):
+    """Encode ``features`` and run the hardware-efficient ansatz on the
+    sharded state. Mirrors circuits.ansatz.hardware_efficient gate-for-gate,
+    and models.vqc.noisy_forward_state channel-for-channel when ``channels``
+    (stacked Kraus sets) is non-empty: each channel acts on every qubit
+    after every ansatz layer, keyed with the dense engine's exact fold
+    layout so sharded and dense trajectories coincide sample-for-sample."""
     n = ctx.n_qubits
-    state = product_state_local(ctx, angle_amplitudes(features * jnp.pi, "ry"))
+    state = sharded_encoded_state(ctx, features, encoding)
     n_layers = params["rx"].shape[0]
     for layer in range(n_layers):
         for q in range(n):
@@ -46,6 +69,10 @@ def sharded_hea_state(ctx: ShardCtx, features: jnp.ndarray, params: dict):
                 state = apply_gate_2q_sharded(ctx, state, gates.CNOT, q, q + 1)
             if n > 2:
                 state = apply_gate_2q_sharded(ctx, state, gates.CNOT, n - 1, 0)
+        for ci, kraus in enumerate(channels):
+            state = apply_channel_all_sharded(
+                ctx, state, kraus, jax.random.fold_in(key, layer * 8 + ci)
+            )
     return state
 
 

@@ -82,32 +82,68 @@ def fed_mesh(
     return Mesh(arr, (clients_axis, sv_axis))
 
 
-def hybrid_fed_mesh(
-    sv_size: int = 1,
-    clients_axis: str = "clients",
-    sv_axis: str = "sv",
-) -> Mesh:
-    """Multi-slice-aware (clients, sv) mesh.
+def hybrid_device_array(devs, sv_size: int) -> np.ndarray:
+    """(clients, sv) device array with every sv group inside one slice.
 
-    Uses ``mesh_utils.create_hybrid_device_mesh`` when more than one slice
-    is present so the clients axis crosses DCN and the sv axis never does;
-    falls back to ``fed_mesh`` on a single slice/host.
+    The arrangement policy, separated from ``Mesh`` construction so it is
+    unit-testable with fake devices: group by ``slice_index`` (absent ⇒
+    slice 0), order slices by index, arrange each slice's devices into
+    (groups, sv) — topology-aware via ``mesh_utils.create_device_mesh``
+    (physical torus coordinates) for real TPU devices, falling back to
+    id-order contiguous runs (jax's ICI-adjacent enumeration) for fakes or
+    platforms without coords — and stack the groups of all slices along
+    the clients axis. The sv axis therefore never crosses DCN; the clients
+    axis does — the §header bandwidth policy. Slices must be equal-sized
+    and divisible by ``sv_size``.
     """
-    devs = jax.devices()
-    num_slices = len({getattr(d, "slice_index", 0) for d in devs})
-    if num_slices <= 1:
-        return fed_mesh(sv_size, clients_axis, sv_axis)
-    from jax.experimental import mesh_utils
-
-    per_slice = len(devs) // num_slices
+    slices: dict[int, list] = {}
+    for d in devs:
+        slices.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    sizes = {len(v) for v in slices.values()}
+    if len(sizes) > 1:
+        raise ValueError(f"unequal slice sizes {sorted(sizes)}; cannot mesh")
+    per_slice = sizes.pop()
     if per_slice % sv_size != 0:
         raise ValueError(
             f"sv groups must fit within a slice: {per_slice} chips/slice, "
             f"sv_size={sv_size}"
         )
-    arr = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=(per_slice // sv_size, sv_size),
-        dcn_mesh_shape=(num_slices, 1),
-        devices=devs,
-    )
-    return Mesh(arr, (clients_axis, sv_axis))
+
+    def arrange(slice_devs: list) -> np.ndarray:
+        shape = (per_slice // sv_size, sv_size)
+        ordered = sorted(slice_devs, key=lambda d: d.id)
+        if getattr(ordered[0], "platform", None) == "tpu" and hasattr(
+            ordered[0], "coords"
+        ):
+            from jax.experimental import mesh_utils
+
+            try:
+                return np.asarray(
+                    mesh_utils.create_device_mesh(
+                        shape, devices=ordered, allow_split_physical_axes=True
+                    )
+                )
+            except Exception:  # noqa: BLE001 — odd topologies: id-order
+                pass
+        return np.array(ordered, dtype=object).reshape(shape)
+
+    return np.concatenate([arrange(slices[s]) for s in sorted(slices)], axis=0)
+
+
+def hybrid_fed_mesh(
+    sv_size: int = 1,
+    clients_axis: str = "clients",
+    sv_axis: str = "sv",
+    devices=None,
+) -> Mesh:
+    """Multi-slice-aware (clients, sv) mesh.
+
+    On a single slice/host this is exactly ``fed_mesh``; with multiple
+    slices the clients axis crosses DCN and the sv axis never does
+    (``hybrid_device_array``).
+    """
+    devs = jax.devices() if devices is None else devices
+    num_slices = len({getattr(d, "slice_index", 0) for d in devs})
+    if num_slices <= 1:
+        return fed_mesh(sv_size, clients_axis, sv_axis, devices=devs)
+    return Mesh(hybrid_device_array(devs, sv_size), (clients_axis, sv_axis))

@@ -171,6 +171,32 @@ def from_dense(ctx: ShardCtx, state: CArray) -> CArray:
     return CArray(re, jnp.take(flat_im, idx, axis=0))
 
 
+def amplitude_encode_local(ctx: ShardCtx, x: jnp.ndarray) -> CArray:
+    """Local shard of the amplitude-encoded state for feature vector ``x``.
+
+    Mirrors circuits.encoders.amplitude_encode (ℓ2-normalize, all-zero →
+    uniform fallback, reference qAmplitude.py:11-41) on the sharded engine.
+    ``x`` has length 2^n_qubits and is REPLICATED over the sv axis (client
+    features are broadcast, not sharded), so the norm is computed locally —
+    identical on every device, zero communication; each device then slices
+    its 2^n_local contiguous amplitudes (device index = most-significant
+    qubit bits, the ``from_dense`` flattening convention).
+    """
+    x = jnp.asarray(x, dtype=RDTYPE)
+    size = x.shape[-1]
+    if size != (1 << ctx.n_qubits):
+        raise ValueError(
+            f"amplitude encoding needs {1 << ctx.n_qubits} features, got {size}"
+        )
+    norm = jnp.linalg.norm(x)
+    uniform = jnp.full((size,), 1.0 / jnp.sqrt(size), dtype=RDTYPE)
+    safe = jnp.where(norm > 0, x / jnp.where(norm > 0, norm, 1.0), uniform)
+    block = 1 << ctx.n_local
+    idx = jax.lax.axis_index(ctx.axis)
+    shard = jax.lax.dynamic_slice(safe, (idx * block,), (block,))
+    return CArray(shard.reshape((2,) * ctx.n_local), None)
+
+
 # --- gate application ------------------------------------------------------
 
 
@@ -274,6 +300,65 @@ def apply_gate_2q_sharded(
     state = sv.apply_gate_2q(state, gate, ctx.local_axis(a1), ctx.local_axis(a2))
     for g, l in reversed(list(mapping.items())):
         state = swap_global_local(ctx, state, g, l)
+    return state
+
+
+# --- noise channels (stochastic Kraus trajectories) -------------------------
+
+
+def apply_channel_sharded(
+    ctx: ShardCtx, state: CArray, kraus: CArray, qubit: int, key: jax.Array
+) -> CArray:
+    """One sampled Kraus branch of a single-qubit channel on the sharded
+    state — the trajectory unraveling of noise.trajectory.apply_channel at
+    sharded widths (reference ROADMAP.md:64-73 noise at the ≥20-qubit
+    regime).
+
+    Every branch is applied via ``apply_gate_sharded`` (local qubit: free;
+    global qubit: one ppermute per branch). Born weights need the GLOBAL
+    branch norms — one fused psum over all k branches; the categorical
+    sample then uses the replicated key on replicated probs, so every
+    device selects the same branch and the trajectory stays consistent
+    across shards. Matches the dense engine's PRNG layout exactly, so a
+    sharded trajectory equals its dense counterpart sample-for-sample.
+    """
+    n_k = kraus.re.shape[0]
+    outs = [
+        apply_gate_sharded(
+            ctx,
+            state,
+            CArray(kraus.re[i], None if kraus.im is None else kraus.im[i]),
+            qubit,
+        )
+        for i in range(n_k)
+    ]
+    local = jnp.stack([jnp.sum(cabs2(o)) for o in outs])
+    probs = jax.lax.psum(local, ctx.axis)
+    idx = jax.random.categorical(key, jnp.log(jnp.maximum(probs, 1e-30)))
+
+    any_im = any(o.im is not None for o in outs)
+    re = jnp.take(jnp.stack([o.re for o in outs]), idx, axis=0)
+    im = (
+        jnp.take(jnp.stack([o.imag_or_zeros() for o in outs]), idx, axis=0)
+        if any_im
+        else None
+    )
+    norm = jnp.sqrt(jnp.maximum(jnp.take(probs, idx), 1e-30))
+    return CArray(re / norm, None if im is None else im / norm)
+
+
+def apply_channel_all_sharded(
+    ctx: ShardCtx, state: CArray, kraus: CArray, key: jax.Array
+) -> CArray:
+    """The channel independently on every qubit (global and local).
+
+    Key layout matches noise.trajectory.apply_channel_all: one split per
+    qubit, qubit q gets keys[q] — so dense and sharded trajectories of the
+    same circuit consume identical randomness.
+    """
+    keys = jax.random.split(key, ctx.n_qubits)
+    for q in range(ctx.n_qubits):
+        state = apply_channel_sharded(ctx, state, kraus, q, keys[q])
     return state
 
 

@@ -53,6 +53,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--encoding", default="angle",
                    choices=["angle", "amplitude", "reupload"])
     t.add_argument("--landmarks", type=int, default=16)
+    t.add_argument("--sv-size", type=int, default=1,
+                   help="shard each statevector over this many devices "
+                        "(power of two; the >20-qubit regime)")
     t.add_argument("--depolarizing", type=float, default=0.0)
     t.add_argument("--damping", type=float, default=0.0)
     t.add_argument("--readout-flip", type=float, default=0.0)
@@ -73,8 +76,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="enable DP with this L2 clip norm")
     t.add_argument("--dp-sigma", type=float, default=1.0)
     t.add_argument("--secure-agg", action="store_true")
+    t.add_argument("--secure-agg-mode", default="ring", choices=["ring", "pairwise"],
+                   help="pair graph: k-successor ring (O(k)/client) or complete (O(C)/client)")
+    t.add_argument("--secure-agg-neighbors", type=int, default=1,
+                   help="ring hops k; unmasking a client needs its 2k neighbors to collude")
     # run
     t.add_argument("--eval-every", type=int, default=1)
+    t.add_argument("--eval-batches", type=int, default=None,
+                   help="cap per-round eval at this many 256-sample batches")
     t.add_argument("--checkpoint-every", type=int, default=5)
     t.add_argument("--seed", type=int, default=42)
     t.add_argument("--run-root", default="runs")
@@ -90,6 +99,14 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--dataset", default="mnist",
                    choices=["mnist", "fashion_mnist", "cifar10"])
     d.add_argument("--out", default="runs/demo")
+
+    s = sub.add_parser("sweep",
+                       help="config-grid × seeds benchmark harness "
+                            "(mean±std table + roadmap plots)")
+    s.add_argument("--preset", default="roadmap",
+                   choices=["quick", "roadmap", "baseline"])
+    s.add_argument("--seeds", type=int, default=3)
+    s.add_argument("--run-root", default="runs")
     return p
 
 
@@ -116,6 +133,7 @@ def config_from_args(a: argparse.Namespace) -> ExperimentConfig:
             n_layers=a.layers,
             encoding=a.encoding,
             n_landmarks=a.landmarks,
+            sv_size=a.sv_size,
             depolarizing_p=a.depolarizing,
             amp_damping_gamma=a.damping,
             readout_flip=a.readout_flip,
@@ -132,9 +150,12 @@ def config_from_args(a: argparse.Namespace) -> ExperimentConfig:
             client_fraction=a.client_fraction,
             dp=dp,
             secure_agg=a.secure_agg,
+            secure_agg_mode=a.secure_agg_mode,
+            secure_agg_neighbors=a.secure_agg_neighbors,
         ),
         num_rounds=a.rounds,
         eval_every=a.eval_every,
+        eval_batches=a.eval_batches,
         checkpoint_every=a.checkpoint_every,
         seed=a.seed,
         run_root=a.run_root,
@@ -148,7 +169,6 @@ def run_train(
     plots: bool = False,
     profile: bool = False,
 ) -> dict:
-    from qfedx_tpu.fed.evaluate import make_evaluator
     from qfedx_tpu.run.metrics import ExperimentRun
     from qfedx_tpu.run.trainer import train_federated
     from qfedx_tpu.utils.host import is_primary
@@ -200,13 +220,16 @@ def run_train(
                 num_rounds=cfg.num_rounds,
                 seed=cfg.seed,
                 eval_every=cfg.eval_every,
+                eval_batches=cfg.eval_batches,
                 on_round_end=lambda r, m: (
                     run.on_round_end(r, m),
                     say(f"[round {r + 1:3d}] " + json.dumps(m)) if (r + 1) % 5 == 0 else None,
                 )[0],
                 checkpointer=run.checkpointer(every=cfg.checkpoint_every),
             )
-        test_metrics = make_evaluator(model)(result.params, test_x, test_y)
+        # result.evaluate is mesh-aware (sv-sharded models can't be
+        # evaluated through bare model.apply).
+        test_metrics = result.evaluate(result.params, test_x, test_y)
         summary = {
             "final_accuracy": test_metrics["accuracy"],
             "final_val_accuracy": result.final_accuracy if have_val else None,
@@ -243,6 +266,10 @@ def main(argv=None):
         from qfedx_tpu.run.demo import run_demo
 
         run_demo(out_dir=args.out, dataset=args.dataset)
+    elif args.cmd == "sweep":
+        from qfedx_tpu.run.sweep import run_sweep
+
+        run_sweep(preset=args.preset, seeds=args.seeds, root=args.run_root)
 
 
 if __name__ == "__main__":

@@ -39,6 +39,12 @@ class ModelConfig:
     n_qubits: int = 8
     n_layers: int = 2
     encoding: str = "angle"  # angle | amplitude | reupload
+    # Statevector sharding degree (power of two). >1 routes the VQC onto
+    # the device-sharded engine (models.vqc_sharded) — the ≥20-qubit
+    # regime where one chip's HBM can't hold 2^n amplitudes per sample
+    # (reference ROADMAP.md:86; BASELINE.md config 5). The trainer then
+    # builds a (clients, sv) mesh instead of a 1-D client mesh.
+    sv_size: int = 1
     n_landmarks: int = 16  # qkernel only
     # noise (ROADMAP.md:64-73); zeros = noiseless
     depolarizing_p: float = 0.0
@@ -55,6 +61,7 @@ class ExperimentConfig:
     fed: FedConfig = field(default_factory=FedConfig)
     num_rounds: int = 30  # reference Classical_FL.py:168
     eval_every: int = 1
+    eval_batches: int | None = None  # cap eval cost on large eval sets
     checkpoint_every: int = 5
     seed: int = 42
     run_root: str = "runs"
@@ -104,6 +111,22 @@ def build_model(cfg: ExperimentConfig, num_classes: int):
                 readout_e10=m.readout_flip,
                 shots=m.shots,
                 circuit_level=(m.noise_placement == "circuit"),
+            )
+        if m.sv_size > 1:
+            from qfedx_tpu.models.vqc_sharded import make_sharded_vqc_classifier
+
+            if m.encoding == "reupload":
+                raise ValueError(
+                    "sv_size > 1 supports angle/amplitude encodings "
+                    "(data reuploading is a dense-engine feature)"
+                )
+            return make_sharded_vqc_classifier(
+                n_qubits=m.n_qubits,
+                sv_size=m.sv_size,
+                n_layers=m.n_layers,
+                num_classes=num_classes,
+                encoding=m.encoding,
+                noise_model=noise_model,
             )
         return make_vqc_classifier(
             n_qubits=m.n_qubits,

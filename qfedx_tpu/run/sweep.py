@@ -1,0 +1,304 @@
+"""Experiment sweep harness: config grid × seeds → mean±std table + plots.
+
+The reference roadmap's evaluation protocol (reference ROADMAP.md:102-120)
+specifies a config grid — qubits {2,4,8}, Dirichlet α {0.1,0.3,1.0},
+client fraction p {0.1,0.3,1.0} — with every cell run on 3–5 seeds and
+reported as mean±std accuracy/AUC/ε plus wall-clock and MB/round, and
+three summary plots: accuracy-vs-ε, accuracy-vs-qubits, and
+speedup-vs-N-clients. None of that existed in the reference (it has no
+benchmark harness at all, SURVEY.md §6); this module is that harness.
+
+One command:  ``python -m qfedx_tpu sweep --preset roadmap --seeds 3``.
+Writes ``<root>/sweep-<preset>/results.json`` (every cell, every seed, and
+the aggregates), ``results.md`` (the mean±std table), and the three PNGs.
+Cells run sequentially through the same ``build_data → build_model →
+train_federated`` path as ``train`` — the sweep measures exactly what the
+CLI runs.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+
+from qfedx_tpu.fed.config import DPConfig, FedConfig
+from qfedx_tpu.run.config import (
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    build_data,
+    build_model,
+)
+
+# Kept small enough that the full preset finishes on an 8-device CPU mesh
+# in tens of minutes; the flagship cells match BASELINE.md shapes at
+# reduced round counts (the harness measures the protocol, not SOTA).
+_COMMON = dict(rounds=8, local_epochs=1, batch_size=32, lr=0.1, optimizer="adam")
+
+
+def _cell(name: str, **kw) -> dict:
+    out = dict(_COMMON)
+    out.update(kw)
+    out["name"] = name
+    return out
+
+
+def preset_cells(preset: str) -> list[dict]:
+    """The config grid for a preset. Each cell is a flat dict of knobs."""
+    if preset == "quick":  # CI-sized: 2 cells
+        return [
+            _cell("q4-iid", qubits=4, clients=4, rounds=4),
+            _cell("q4-dp", qubits=4, clients=4, rounds=4, dp_sigma=1.0, dp_clip=1.0),
+        ]
+    if preset == "roadmap":
+        # ROADMAP.md:105-107 grid: qubits × α (non-IID skew) × p (sampling).
+        cells = []
+        for q in (2, 4, 8):
+            cells.append(_cell(f"q{q}-iid", qubits=q, clients=8))
+        for alpha in (0.1, 0.3, 1.0):
+            cells.append(
+                _cell(f"q4-a{alpha}", qubits=4, clients=8,
+                      partition="dirichlet", alpha=alpha)
+            )
+        for p in (0.1, 0.3, 1.0):
+            cells.append(_cell(f"q4-p{p}", qubits=4, clients=8, client_fraction=p))
+        for sigma in (0.5, 1.0, 2.0):
+            cells.append(
+                _cell(f"q4-dp{sigma}", qubits=4, clients=8,
+                      dp_sigma=sigma, dp_clip=1.0)
+            )
+        # Scaling axis: SAME model/config, ONLY the cohort size varies —
+        # the one comparison the speedup-vs-clients plot may draw from.
+        for c in (2, 8, 32):
+            cells.append(_cell(f"q4-c{c}", qubits=4, clients=c, scaling=True))
+        return cells
+    if preset == "baseline":
+        # BASELINE.md configs 1–5 at harness scale (client counts kept true;
+        # rounds reduced; config 5's 20q/256c runs as sv-sharded 8q/32c on
+        # the 8-device mesh — same program, smaller shapes).
+        return [
+            _cell("c1-4q-2cli", qubits=4, clients=2, classes=(0, 1)),
+            _cell("c2-8q-dp", qubits=8, clients=10, partition="dirichlet",
+                  alpha=0.5, dp_sigma=1.0, dp_clip=1.0),
+            _cell("c3-cnn-fedprox", model="cnn", clients=32, algorithm="fedprox",
+                  prox_mu=0.01, rounds=4),
+            _cell("c4-12q-reupload-secagg", qubits=12, clients=64,
+                  encoding="reupload", secure_agg=True, rounds=4),
+            _cell("c5-svqc-qkernel", qubits=8, clients=32, sv_size=4, rounds=4),
+        ]
+    raise ValueError(f"unknown preset {preset!r}")
+
+
+def _config_from_cell(cell: dict, seed: int) -> ExperimentConfig:
+    dp = None
+    if cell.get("dp_clip") is not None:
+        dp = DPConfig(
+            clip_norm=cell["dp_clip"], noise_multiplier=cell.get("dp_sigma", 1.0)
+        )
+    return ExperimentConfig(
+        data=DataConfig(
+            classes=cell.get("classes", (0, 1, 2)),
+            num_clients=cell.get("clients", 4),
+            partition=cell.get("partition", "iid"),
+            alpha=cell.get("alpha", 0.5),
+            seed=seed,
+        ),
+        model=ModelConfig(
+            model=cell.get("model", "vqc"),
+            n_qubits=cell.get("qubits", 4),
+            n_layers=cell.get("layers", 2),
+            encoding=cell.get("encoding", "angle"),
+            sv_size=cell.get("sv_size", 1),
+        ),
+        fed=FedConfig(
+            local_epochs=cell.get("local_epochs", 1),
+            batch_size=cell.get("batch_size", 32),
+            learning_rate=cell.get("lr", 0.1),
+            optimizer=cell.get("optimizer", "adam"),
+            algorithm=cell.get("algorithm", "fedavg"),
+            prox_mu=cell.get("prox_mu", 0.0),
+            client_fraction=cell.get("client_fraction", 1.0),
+            dp=dp,
+            secure_agg=cell.get("secure_agg", False),
+        ),
+        num_rounds=cell.get("rounds", 8),
+        eval_every=max(1, cell.get("rounds", 8) // 2),
+        seed=seed,
+    )
+
+
+def _run_cell(cell: dict, seed: int) -> dict:
+    """One (cell, seed) training run → its summary metrics."""
+    from qfedx_tpu.run.trainer import train_federated
+
+    cfg = _config_from_cell(cell, seed)
+    data = build_data(cfg)
+    model = build_model(cfg, data["num_classes"])
+    test_x, test_y = data["test"]
+    t0 = time.perf_counter()
+    res = train_federated(
+        model,
+        cfg.fed,
+        data["cx"],
+        data["cy"],
+        data["cmask"],
+        test_x,
+        test_y,
+        num_rounds=cfg.num_rounds,
+        seed=seed,
+        eval_every=cfg.eval_every,
+    )
+    wall = time.perf_counter() - t0
+    final = res.evaluate(res.params, test_x, test_y)
+    return {
+        "accuracy": final["accuracy"],
+        "auc": final.get("auc"),
+        "epsilon": res.epsilons[-1] if res.epsilons else None,
+        "wall_s": wall,
+        "round_s": float(np.mean(res.round_times_s)) if res.round_times_s else None,
+        "comm_mb_per_round": res.comm_mb_per_round,
+    }
+
+
+def _aggregate(runs: list[dict]) -> dict:
+    """Per-cell mean±std over seeds (ROADMAP.md:119's reporting rule)."""
+    out = {}
+    for key in ("accuracy", "auc", "epsilon", "wall_s", "round_s"):
+        vals = [r[key] for r in runs if r.get(key) is not None]
+        if vals:
+            out[f"{key}_mean"] = float(np.mean(vals))
+            out[f"{key}_std"] = float(np.std(vals))
+    out["comm_mb_per_round"] = runs[0]["comm_mb_per_round"]
+    out["n_seeds"] = len(runs)
+    return out
+
+
+def _markdown_table(cells: list[dict], aggs: dict) -> str:
+    lines = [
+        "| cell | accuracy | AUC | ε | round s | MB/round |",
+        "|---|---|---|---|---|---|",
+    ]
+    for c in cells:
+        a = aggs[c["name"]]
+        fmt = lambda k: (
+            f"{a[f'{k}_mean']:.3f}±{a[f'{k}_std']:.3f}" if f"{k}_mean" in a else "—"
+        )
+        lines.append(
+            f"| {c['name']} | {fmt('accuracy')} | {fmt('auc')} | {fmt('epsilon')} "
+            f"| {fmt('round_s')} | {a['comm_mb_per_round']:.4f} |"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def _plots(out_dir: Path, cells: list[dict], aggs: dict) -> None:
+    """The three ROADMAP.md:120 plots, from whatever cells the preset has."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    def errbar(ax, xs, names, key="accuracy"):
+        ys = [aggs[n][f"{key}_mean"] for n in names]
+        es = [aggs[n][f"{key}_std"] for n in names]
+        ax.errorbar(xs, ys, yerr=es, marker="o", capsize=3)
+
+    # accuracy vs ε — DP cells only
+    dp_cells = [c for c in cells if aggs[c["name"]].get("epsilon_mean") is not None]
+    if dp_cells:
+        fig, ax = plt.subplots(figsize=(5, 4))
+        errbar(ax, [aggs[c["name"]]["epsilon_mean"] for c in dp_cells],
+               [c["name"] for c in dp_cells])
+        ax.set_xlabel("ε (δ=1e-5)")
+        ax.set_ylabel("test accuracy")
+        ax.set_title("privacy/utility")
+        fig.savefig(out_dir / "accuracy_vs_epsilon.png", dpi=120,
+                    bbox_inches="tight")
+        plt.close(fig)
+
+    # accuracy vs qubits — vqc cells grouped by qubit count
+    q_cells = {}
+    for c in cells:
+        if c.get("model", "vqc") == "vqc" and not c.get("dp_clip"):
+            q_cells.setdefault(c.get("qubits", 4), c["name"])
+    if len(q_cells) >= 2:
+        fig, ax = plt.subplots(figsize=(5, 4))
+        qs = sorted(q_cells)
+        errbar(ax, qs, [q_cells[q] for q in qs])
+        ax.set_xlabel("qubits")
+        ax.set_ylabel("test accuracy")
+        ax.set_title("accuracy vs circuit width")
+        fig.savefig(out_dir / "accuracy_vs_qubits.png", dpi=120,
+                    bbox_inches="tight")
+        plt.close(fig)
+
+    # speedup vs clients: per-round time scaling, drawn ONLY from cells
+    # explicitly marked scaling=True (same model/config, cohort size the
+    # single varying knob) — mixing heterogeneous cells here would publish
+    # apples-to-oranges throughput ratios as a scaling curve.
+    cli_cells = sorted(
+        ((c.get("clients", 4), c["name"]) for c in cells
+         if c.get("scaling") and aggs[c["name"]].get("round_s_mean")),
+    )
+    if len(cli_cells) >= 2:
+        base_c, base_name = cli_cells[0]
+        base = aggs[base_name]["round_s_mean"] / base_c  # s per client-round
+        fig, ax = plt.subplots(figsize=(5, 4))
+        xs = [c for c, _ in cli_cells]
+        ys = [base * c / aggs[n]["round_s_mean"] for c, n in cli_cells]
+        ax.plot(xs, ys, marker="o", label="measured")
+        ax.plot(xs, [x / xs[0] for x in xs], "--", label="ideal")
+        ax.set_xlabel("clients")
+        ax.set_ylabel("client-round throughput speedup")
+        ax.set_title("scaling with cohort size")
+        ax.legend()
+        fig.savefig(out_dir / "speedup_vs_clients.png", dpi=120,
+                    bbox_inches="tight")
+        plt.close(fig)
+
+
+def run_sweep(
+    preset: str = "quick",
+    seeds: int = 3,
+    root: str = "runs",
+    cells: list[dict] | None = None,
+) -> dict:
+    """Run the grid; returns {"cells": ..., "aggregates": ..., "dir": ...}."""
+    from qfedx_tpu.utils.host import is_primary
+
+    say = print if is_primary() else (lambda *a, **k: None)
+    cells = cells if cells is not None else preset_cells(preset)
+    out_dir = Path(root) / f"sweep-{preset}"
+    if is_primary():
+        out_dir.mkdir(parents=True, exist_ok=True)
+
+    all_runs: dict[str, list[dict]] = {}
+    for ci, cell in enumerate(cells):
+        runs = []
+        for s in range(seeds):
+            t0 = time.perf_counter()
+            runs.append(_run_cell(cell, seed=42 + s))
+            say(
+                f"[sweep {ci + 1}/{len(cells)}] {cell['name']} seed {s}: "
+                f"acc={runs[-1]['accuracy']:.3f} "
+                f"({time.perf_counter() - t0:.1f}s)"
+            )
+        all_runs[cell["name"]] = runs
+
+    aggs = {name: _aggregate(runs) for name, runs in all_runs.items()}
+    result = {
+        "preset": preset,
+        "seeds": seeds,
+        "cells": [dict(c) for c in cells],
+        "runs": all_runs,
+        "aggregates": aggs,
+    }
+    if is_primary():
+        (out_dir / "results.json").write_text(json.dumps(result, indent=2))
+        (out_dir / "results.md").write_text(_markdown_table(cells, aggs))
+        _plots(out_dir, cells, aggs)
+    result["dir"] = str(out_dir)
+    say(f"[sweep] wrote {out_dir}/results.json, results.md, plots")
+    return result

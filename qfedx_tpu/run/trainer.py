@@ -35,6 +35,12 @@ class TrainResult:
     epsilons: list[float] = field(default_factory=list)
     round_times_s: list[float] = field(default_factory=list)
     comm_mb_per_round: float = 0.0
+    # Mesh-aware UNCAPPED evaluator (callers must not build their own via
+    # bare model.apply for sv-sharded models; eval_batches caps only the
+    # per-round pacing evals, never metrics reported through this) and the
+    # mesh the run used.
+    evaluate: Callable | None = None
+    mesh: Any = None
 
     @property
     def final_accuracy(self) -> float:
@@ -53,6 +59,7 @@ def train_federated(
     seed: int = 42,
     mesh=None,
     eval_every: int = 1,
+    eval_batches: int | None = None,
     on_round_end: Callable[[int, dict], None] | None = None,
     checkpointer=None,
 ) -> TrainResult:
@@ -66,14 +73,47 @@ def train_federated(
     """
     num_clients = cx.shape[0]
     if mesh is None:
-        # Largest device count that divides the client count (1 client block
-        # per device; SURVEY §7.3.5's inner vmap handles blocks > 1).
-        n_dev = min(len(jax.devices()), num_clients)
-        while num_clients % n_dev != 0:
-            n_dev -= 1
-        mesh = client_mesh(num_devices=n_dev)
+        if model.sv_size > 1:
+            # sv-sharded model: (clients, sv) mesh. Each sv group must be a
+            # contiguous ICI-adjacent device run (parallel.mesh policy);
+            # the clients axis takes whatever groups remain and must
+            # divide the client count.
+            avail = len(jax.devices()) // model.sv_size
+            if avail < 1:
+                raise ValueError(
+                    f"model needs sv groups of {model.sv_size} devices; "
+                    f"only {len(jax.devices())} available"
+                )
+            n_cli_dev = min(avail, num_clients)
+            while num_clients % n_cli_dev != 0:
+                n_cli_dev -= 1
+            from qfedx_tpu.parallel.mesh import fed_mesh
+
+            mesh = fed_mesh(
+                sv_size=model.sv_size,
+                sv_axis=model.sv_axis,
+                num_client_devices=n_cli_dev,
+            )
+        else:
+            # Largest device count that divides the client count (1 client
+            # block per device; SURVEY §7.3.5's inner vmap handles > 1).
+            n_dev = min(len(jax.devices()), num_clients)
+            while num_clients % n_dev != 0:
+                n_dev -= 1
+            mesh = client_mesh(num_devices=n_dev)
     round_fn = make_fed_round(model, cfg, mesh, num_clients=num_clients)
-    evaluate = make_evaluator(model)
+    # Two evaluators: the capped one paces per-round eval (eval_batches
+    # bounds its cost); the uncapped one is exposed on TrainResult so final
+    # reported metrics always cover the full eval set.
+    if model.sv_size > 1:
+        from qfedx_tpu.models.vqc_sharded import host_apply
+
+        apply_fn = host_apply(model, mesh, sv_axis=model.sv_axis)
+        evaluate = make_evaluator(model, apply_fn=apply_fn, max_batches=eval_batches)
+        evaluate_full = make_evaluator(model, apply_fn=apply_fn)
+    else:
+        evaluate = make_evaluator(model, max_batches=eval_batches)
+        evaluate_full = make_evaluator(model)
 
     key = jax.random.PRNGKey(seed)
     init_key, round_key_base = jax.random.split(key)
@@ -108,10 +148,18 @@ def train_federated(
     comm_mb = 2 * n_params * 4 / 1e6
 
     result = TrainResult(
-        params=params, accuracies=[], losses=[], comm_mb_per_round=comm_mb
+        params=params,
+        accuracies=[],
+        losses=[],
+        comm_mb_per_round=comm_mb,
+        evaluate=evaluate_full,
+        mesh=mesh,
     )
-    metrics0 = evaluate(params, test_x, test_y)
-    result.accuracies.append(metrics0["accuracy"])
+    # Round-0 (pre-training) accuracy — skipped when eval is effectively
+    # off (eval_every > num_rounds), where it would only cost a compile.
+    if eval_every <= num_rounds:
+        metrics0 = evaluate(params, test_x, test_y)
+        result.accuracies.append(metrics0["accuracy"])
 
     for rnd in range(start_round, num_rounds):
         t0 = time.perf_counter()

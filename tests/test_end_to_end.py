@@ -74,6 +74,20 @@ def test_cnn_same_harness_converges():
     assert res.final_accuracy > 0.9, f"accuracies: {res.accuracies}"
 
 
+def test_centralized_vqc_baseline_converges():
+    """The centralized-VQC baseline (reference ROADMAP.md:109): one client
+    holding all data on the same harness — the apples-to-apples anchor the
+    federated accuracies are compared against."""
+    (cx, cy, cmask), (tx, ty), k = _vqc_data(num_clients=1, train=512, test=128)
+    assert cx.shape[0] == 1
+    model = make_vqc_classifier(n_qubits=4, n_layers=3, num_classes=k)
+    cfg = FedConfig(local_epochs=4, batch_size=32, learning_rate=0.1, optimizer="adam")
+    res = train_federated(
+        model, cfg, cx, cy, cmask, tx, ty, num_rounds=10, eval_every=5, seed=0
+    )
+    assert res.final_accuracy > 0.95, f"accuracies: {res.accuracies}"
+
+
 def test_reupload_vqc_trains():
     (cx, cy, cmask), (tx, ty), k = _vqc_data(train=512, test=128)
     model = make_vqc_classifier(n_qubits=4, n_layers=2, num_classes=k, encoding="reupload")

@@ -171,8 +171,14 @@ def test_secure_agg_cancels_under_sampling(mesh):
     params = model.init(jax.random.PRNGKey(0))
     key = jax.random.PRNGKey(13)
     plain = make_fed_round(model, FedConfig(**base), mesh, num_clients=8)
+    # complete pair graph here; the default ring graph is covered above and
+    # at 256 clients below — both graphs must cancel under sampling.
     masked = make_fed_round(
-        model, FedConfig(**base, secure_agg=True, secure_agg_scale=3.0), mesh, num_clients=8
+        model,
+        FedConfig(**base, secure_agg=True, secure_agg_scale=3.0,
+                  secure_agg_mode="pairwise"),
+        mesh,
+        num_clients=8,
     )
     p_plain, s_plain = plain(params, cx, cy, jnp.asarray(cmask), key)
     p_masked, s_masked = masked(params, cx, cy, jnp.asarray(cmask), key)
@@ -181,6 +187,62 @@ def test_secure_agg_cancels_under_sampling(mesh):
         np.testing.assert_allclose(
             np.asarray(p_plain[k]), np.asarray(p_masked[k]), atol=1e-4
         )
+
+
+def test_round_equality_at_64_clients(mesh):
+    """BASELINE config-4 client count: 64 clients = blocks of 8 per device;
+    the SPMD round must still match the sequential oracle exactly."""
+    model = linear_model()
+    cfg = FedConfig(local_epochs=1, batch_size=8, learning_rate=0.1, momentum=0.0)
+    cx, cy, cmask, _ = make_client_data(num_clients=64)
+    params = model.init(jax.random.PRNGKey(0))
+    round_key = jax.random.PRNGKey(21)
+    round_fn = make_fed_round(model, cfg, mesh, num_clients=64)
+    new_params, stats = round_fn(params, cx, cy, jnp.asarray(cmask), round_key)
+    expected = _sequential_round(model, cfg, params, cx, cy, cmask, round_key, 64)
+    for k in expected:
+        np.testing.assert_allclose(
+            np.asarray(new_params[k]), np.asarray(expected[k]), atol=1e-5
+        )
+    assert float(stats.num_participants) == 64
+
+
+def test_secure_agg_ring_at_256_clients(mesh):
+    """BASELINE config-5 client count with ring secure-agg + sampling:
+    masked round ≡ plain round, and the round stays fast (the O(C²)
+    complete graph would sample 65,536 PRG trees here; the ring samples
+    512)."""
+    import time
+
+    model = linear_model()
+    base = dict(
+        local_epochs=1, batch_size=8, learning_rate=0.1, momentum=0.0,
+        client_fraction=0.5,
+    )
+    cx, cy, cmask, _ = make_client_data(num_clients=256, samples=8)
+    params = model.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(17)
+    plain = make_fed_round(model, FedConfig(**base), mesh, num_clients=256)
+    masked = make_fed_round(
+        model,
+        FedConfig(**base, secure_agg=True, secure_agg_scale=3.0,
+                  secure_agg_mode="ring", secure_agg_neighbors=2),
+        mesh,
+        num_clients=256,
+    )
+    p_plain, s_plain = plain(params, cx, cy, jnp.asarray(cmask), key)
+    p_masked, s_masked = masked(params, cx, cy, jnp.asarray(cmask), key)
+    jax.block_until_ready(p_masked)
+    t0 = time.perf_counter()
+    p_masked2, _ = masked(params, cx, cy, jnp.asarray(cmask), key)
+    jax.block_until_ready(p_masked2)
+    steady = time.perf_counter() - t0
+    assert float(s_plain.num_participants) == float(s_masked.num_participants)
+    for k in p_plain:
+        np.testing.assert_allclose(
+            np.asarray(p_plain[k]), np.asarray(p_masked[k]), atol=2e-4
+        )
+    assert steady < 10.0, f"steady-state 256-client masked round took {steady:.1f}s"
 
 
 def test_dp_clip_bounds_update_and_noise_present(mesh):

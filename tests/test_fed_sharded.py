@@ -103,6 +103,136 @@ def test_fed_round_2d_converges(mesh2d, models):
     assert losses[-1] < losses[0], losses
 
 
+def test_sharded_amplitude_encoding_matches_dense(mesh2d):
+    """Amplitude encoding on the sharded engine (2^n features → sharded
+    state) ≡ dense, including the all-zero → uniform fallback."""
+    dense = make_vqc_classifier(
+        N_QUBITS, n_layers=2, num_classes=2, encoding="amplitude"
+    )
+    sharded = make_sharded_vqc_classifier(
+        N_QUBITS, sv_size=4, n_layers=2, num_classes=2, encoding="amplitude"
+    )
+    params = dense.init(jax.random.PRNGKey(1))
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(5, 1 << N_QUBITS)).astype(np.float32)
+    x[2] = 0.0  # uniform-superposition fallback row
+    got = np.asarray(host_apply(sharded, mesh2d)(params, jnp.asarray(x)))
+    want = np.asarray(dense.apply(params, jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_sharded_readout_noise_matches_dense(mesh2d):
+    """Analytic readout channels act on the replicated post-psum ⟨Z⟩, so
+    sharded eval under noise ≡ dense eval under the same NoiseModel."""
+    from qfedx_tpu.noise.channels import NoiseModel
+
+    nm = NoiseModel(depolarizing_p=0.2, amp_damping_gamma=0.1, readout_e01=0.05,
+                    readout_e10=0.05)
+    dense = make_vqc_classifier(N_QUBITS, n_layers=2, num_classes=2, noise_model=nm)
+    sharded = make_sharded_vqc_classifier(
+        N_QUBITS, sv_size=4, n_layers=2, num_classes=2, noise_model=nm
+    )
+    params = dense.init(jax.random.PRNGKey(2))
+    x = jnp.asarray(
+        np.random.default_rng(4).uniform(0, 1, (4, N_QUBITS)), dtype=jnp.float32
+    )
+    got = np.asarray(host_apply(sharded, mesh2d)(params, x))
+    want = np.asarray(dense.apply(params, x))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_sharded_trajectory_noise_matches_dense_sample_for_sample(mesh2d):
+    """Circuit-level Kraus trajectories: the sharded engine computes global
+    branch norms (psum) and samples with the replicated key using the dense
+    engine's exact fold layout — so the SAME key must select the SAME
+    branches and produce identical logits, not just equal distributions."""
+    from qfedx_tpu.noise.channels import NoiseModel
+
+    nm = NoiseModel(depolarizing_p=0.15, amp_damping_gamma=0.1, circuit_level=True)
+    dense = make_vqc_classifier(N_QUBITS, n_layers=2, num_classes=2, noise_model=nm)
+    sharded = make_sharded_vqc_classifier(
+        N_QUBITS, sv_size=4, n_layers=2, num_classes=2, noise_model=nm
+    )
+    assert dense.apply_train is not None and sharded.apply_train is not None
+    params = dense.init(jax.random.PRNGKey(5))
+    x = jnp.asarray(
+        np.random.default_rng(6).uniform(0, 1, (4, N_QUBITS)), dtype=jnp.float32
+    )
+    key = jax.random.PRNGKey(77)
+    from jax.sharding import PartitionSpec as P
+
+    sh_fn = jax.jit(
+        jax.shard_map(
+            sharded.apply_train,
+            mesh=mesh2d,
+            in_specs=(P(), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    got = np.asarray(sh_fn(params, x, key))
+    want = np.asarray(dense.apply_train(params, x, key))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_sharded_shots_train_matches_dense(mesh2d):
+    """Finite-shot training noise: replicated key ⇒ identical binomial
+    draws on sharded and dense paths."""
+    from jax.sharding import PartitionSpec as P
+
+    from qfedx_tpu.noise.channels import NoiseModel
+
+    nm = NoiseModel(shots=128)
+    dense = make_vqc_classifier(N_QUBITS, n_layers=1, num_classes=2, noise_model=nm)
+    sharded = make_sharded_vqc_classifier(
+        N_QUBITS, sv_size=4, n_layers=1, num_classes=2, noise_model=nm
+    )
+    params = dense.init(jax.random.PRNGKey(8))
+    x = jnp.asarray(
+        np.random.default_rng(9).uniform(0, 1, (4, N_QUBITS)), dtype=jnp.float32
+    )
+    key = jax.random.PRNGKey(21)
+    sh_fn = jax.jit(
+        jax.shard_map(
+            sharded.apply_train,
+            mesh=mesh2d,
+            in_specs=(P(), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    got = np.asarray(sh_fn(params, x, key))
+    want = np.asarray(dense.apply_train(params, x, key))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+    # eval stays exact/deterministic
+    e1 = np.asarray(host_apply(sharded, mesh2d)(params, x))
+    e2 = np.asarray(host_apply(sharded, mesh2d)(params, x))
+    np.testing.assert_allclose(e1, e2)
+
+
+def test_cli_sv_size_trains_end_to_end(tmp_path):
+    """VERDICT round-1 item 2 criterion: the CLI-built sharded path —
+    ``train --model vqc --qubits 8 --sv-size 4`` — runs on the 8-device
+    mesh (2 client groups × 4-way sv sharding) and produces run artifacts."""
+    from qfedx_tpu.run.cli import build_parser, config_from_args, run_train
+
+    cfg = config_from_args(
+        build_parser().parse_args(
+            [
+                "train", "--model", "vqc", "--qubits", "8", "--sv-size", "4",
+                "--layers", "1", "--classes", "0,1", "--clients", "4",
+                "--rounds", "2", "--local-epochs", "1", "--batch-size", "8",
+                "--lr", "0.1", "--optimizer", "adam",
+                "--run-root", str(tmp_path), "--name", "sv",
+            ]
+        )
+    )
+    assert cfg.model.sv_size == 4
+    summary = run_train(cfg)
+    assert 0.0 <= summary["final_accuracy"] <= 1.0
+    assert (tmp_path / "sv" / "summary.json").exists()
+
+
 def test_mesh_validation():
     with pytest.raises(ValueError, match="power of two"):
         make_sharded_vqc_classifier(6, sv_size=3)

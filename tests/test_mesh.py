@@ -1,10 +1,26 @@
-"""Mesh construction helpers (single-host paths on the virtual 8-CPU mesh)."""
+"""Mesh construction helpers (single-host paths on the virtual 8-CPU mesh;
+the multi-slice arrangement policy with fake slice-tagged devices)."""
+
+from types import SimpleNamespace
 
 import jax
 import numpy as np
 import pytest
 
-from qfedx_tpu.parallel.mesh import fed_mesh, hybrid_fed_mesh
+from qfedx_tpu.parallel.mesh import fed_mesh, hybrid_device_array, hybrid_fed_mesh
+
+
+def fake_devices(num_slices, per_slice):
+    """Fake TPU devices carrying the ``slice_index`` attribute, interleaved
+    across slices the way jax.devices() can return them on multi-slice."""
+    devs = [
+        SimpleNamespace(id=s * per_slice + i, slice_index=s, platform="tpu")
+        for s in range(num_slices)
+        for i in range(per_slice)
+    ]
+    # shuffle deterministically: the policy must not rely on input order
+    rng = np.random.default_rng(0)
+    return [devs[i] for i in rng.permutation(len(devs))]
 
 
 def test_fed_mesh_shapes():
@@ -26,3 +42,36 @@ def test_fed_mesh_divisibility():
 def test_hybrid_falls_back_on_single_slice():
     m = hybrid_fed_mesh(sv_size=2)
     assert m.shape == {"clients": 4, "sv": 2}
+
+
+def test_hybrid_array_keeps_sv_groups_within_a_slice():
+    """The DCN branch (untested in round 1): every sv group must sit inside
+    one slice — the sv axis exchanges half a statevector per gate and must
+    ride ICI, never DCN (module header policy)."""
+    arr = hybrid_device_array(fake_devices(num_slices=4, per_slice=8), sv_size=4)
+    assert arr.shape == (8, 4)  # 32 devices → 8 client groups × sv 4
+    for row in arr:
+        assert len({d.slice_index for d in row}) == 1  # sv never crosses DCN
+    # clients axis spans all slices (DCN-tolerant axis outermost)
+    assert {row[0].slice_index for row in arr} == {0, 1, 2, 3}
+    # slices appear in index order, and devices within a group are the
+    # slice's contiguous id run (ICI adjacency proxy)
+    assert [row[0].slice_index for row in arr] == [0, 0, 1, 1, 2, 2, 3, 3]
+    for row in arr:
+        ids = [d.id for d in row]
+        assert ids == list(range(min(ids), min(ids) + 4))
+
+
+def test_hybrid_array_validates_fit_and_balance():
+    with pytest.raises(ValueError, match="fit within a slice"):
+        hybrid_device_array(fake_devices(2, 4), sv_size=8)
+    lopsided = fake_devices(2, 4)[:-1]  # one slice loses a device
+    with pytest.raises(ValueError, match="unequal slice"):
+        hybrid_device_array(lopsided, sv_size=2)
+
+
+def test_hybrid_fed_mesh_multi_slice_sv1_shape():
+    """sv_size=1 multi-slice: pure client parallelism, one column."""
+    arr = hybrid_device_array(fake_devices(num_slices=2, per_slice=4), sv_size=1)
+    assert arr.shape == (8, 1)
+    assert [d.slice_index for d in arr[:, 0]] == [0, 0, 0, 0, 1, 1, 1, 1]

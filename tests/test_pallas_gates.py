@@ -66,6 +66,16 @@ def test_gradients_match_tensordot_path():
     )
 
 
+def test_routing_eligibility():
+    """apply_gate routes to the kernel only where blocks stay lane-aligned:
+    R = 2^(n-q-1) ≥ 128 (measured on v5e: smaller R padded every block
+    128/R× under (8,128) tiling and blew the scoped-vmem limit)."""
+    assert pg.pallas_eligible(16, 0)
+    assert pg.pallas_eligible(16, 8)  # R = 128, the boundary
+    assert not pg.pallas_eligible(16, 9)  # R = 64 → would pad 2x
+    assert not pg.pallas_eligible(15, 14)  # last qubit: R = 1
+
+
 def test_state_gradient():
     """VJP w.r.t. the state itself (adjoint application)."""
     n, qubit = 4, 1

@@ -80,17 +80,21 @@ def test_experiment_run_artifacts(tmp_path):
     assert len((run.dir / "metrics.jsonl").read_text().splitlines()) == 1
 
 
-def test_trainer_checkpoint_resume(tmp_path):
-    """Round-K checkpointing + resume through the real trainer loop."""
-    n_qubits, clients, samples = 2, 4, 8
+def _toy_training_setup(n_qubits=2, clients=4, samples=8, seed=0):
     model = make_vqc_classifier(n_qubits=n_qubits, n_layers=1, num_classes=2)
-    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1, optimizer="adam")
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(seed)
     cx = rng.uniform(0, 1, (clients, samples, n_qubits)).astype(np.float32)
     cy = rng.integers(0, 2, (clients, samples)).astype(np.int32)
     cm = np.ones((clients, samples), dtype=np.float32)
     tx = rng.uniform(0, 1, (16, n_qubits)).astype(np.float32)
     ty = rng.integers(0, 2, 16).astype(np.int32)
+    return model, cx, cy, cm, tx, ty
+
+
+def test_trainer_checkpoint_resume(tmp_path):
+    """Round-K checkpointing + resume through the real trainer loop."""
+    model, cx, cy, cm, tx, ty = _toy_training_setup()
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1, optimizer="adam")
 
     ck = Checkpointer(tmp_path, every=1)
     res1 = train_federated(
@@ -105,3 +109,83 @@ def test_trainer_checkpoint_resume(tmp_path):
     )
     assert ck.latest_round() == 3
     assert len(res2.round_times_s) == 1  # only round 3 executed
+
+
+class _SimulatedCrash(RuntimeError):
+    pass
+
+
+def test_crash_mid_run_resumes_bit_exactly(tmp_path):
+    """Fault injection (reference ROADMAP.md:90-91): the process dies
+    mid-loop; a fresh process resuming from the checkpoint must land on
+    BIT-IDENTICAL final params and the same ε as an uninterrupted run —
+    round keys are derived by fold-in from the seed, so the trajectory is
+    reproducible, and restore must not perturb a single bit."""
+    from qfedx_tpu.fed.config import DPConfig
+
+    model, cx, cy, cm, tx, ty = _toy_training_setup()
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.1, optimizer="adam",
+        dp=DPConfig(clip_norm=1.0, noise_multiplier=0.5),
+    )
+
+    # Uninterrupted reference run: 5 rounds.
+    ref = train_federated(
+        model, cfg, cx, cy, cm, tx, ty, num_rounds=5, seed=11,
+        checkpointer=Checkpointer(tmp_path / "ref", every=1),
+    )
+
+    # Crashing run: killed by an injected exception after round 3's
+    # checkpoint hits disk (on_round_end fires after maybe_save).
+    ck = Checkpointer(tmp_path / "crash", every=1)
+
+    def die_at_3(rnd, metrics):
+        if rnd + 1 == 3:
+            raise _SimulatedCrash()
+
+    with pytest.raises(_SimulatedCrash):
+        train_federated(
+            model, cfg, cx, cy, cm, tx, ty, num_rounds=5, seed=11,
+            checkpointer=ck, on_round_end=die_at_3,
+        )
+    assert ck.latest_round() == 3
+
+    # Fresh "process": same config+seed, resumes at round 3, finishes 4-5.
+    res = train_federated(
+        model, cfg, cx, cy, cm, tx, ty, num_rounds=5, seed=11, checkpointer=ck
+    )
+    assert len(res.round_times_s) == 2  # only rounds 4 and 5 ran
+    for got, want in zip(jax.tree.leaves(res.params), jax.tree.leaves(ref.params)):
+        assert np.array_equal(np.asarray(got), np.asarray(want)), "params diverged"
+    # ε accounting replays the checkpointed rounds: final ε identical.
+    assert res.epsilons[-1] == pytest.approx(ref.epsilons[-1], rel=1e-12)
+
+
+def test_client_dropout_mid_run_continues(tmp_path):
+    """Fault injection (reference ROADMAP.md:90-91 "continue despite client
+    dropouts"): a client's data mask zeroes mid-run — later rounds must
+    keep training on the survivors, with the weight totals reflecting the
+    loss and params staying finite."""
+    from qfedx_tpu.fed.round import client_mesh, make_fed_round
+
+    model, cx, cy, cm, tx, ty = _toy_training_setup()
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1, momentum=0.0)
+    mesh = client_mesh(num_devices=4)
+    round_fn = make_fed_round(model, cfg, mesh, num_clients=4)
+    params = model.init(jax.random.PRNGKey(0))
+
+    for rnd in range(3):
+        params, stats = round_fn(
+            params, cx, cy, jnp.asarray(cm), jax.random.PRNGKey(rnd)
+        )
+    assert float(stats.total_weight) == pytest.approx(4 * 8)
+
+    cm_dropped = cm.copy()
+    cm_dropped[1] = 0.0  # client 1 dies between rounds
+    for rnd in range(3, 6):
+        params, stats = round_fn(
+            params, cx, cy, jnp.asarray(cm_dropped), jax.random.PRNGKey(rnd)
+        )
+    assert float(stats.total_weight) == pytest.approx(3 * 8)
+    assert float(stats.num_participants) == 4  # sampled, but one is empty
+    assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(params))

@@ -1,0 +1,38 @@
+"""Sweep harness (reference ROADMAP.md:102-120's evaluation protocol)."""
+
+import json
+
+import pytest
+
+from qfedx_tpu.run.sweep import preset_cells, run_sweep
+
+
+def test_presets_well_formed():
+    for preset in ("quick", "roadmap", "baseline"):
+        cells = preset_cells(preset)
+        assert cells and len({c["name"] for c in cells}) == len(cells)
+    # roadmap carries the spec's grid axes: qubits, α, p, σ
+    names = [c["name"] for c in preset_cells("roadmap")]
+    assert {"q2-iid", "q8-iid", "q4-a0.1", "q4-p0.3", "q4-dp2.0"} <= set(names)
+    with pytest.raises(ValueError, match="unknown preset"):
+        preset_cells("nope")
+
+
+def test_sweep_quick_end_to_end(tmp_path):
+    """2 cells × 2 seeds through the full path: results.json with per-seed
+    runs and mean±std aggregates, the markdown table, and the DP plot."""
+    result = run_sweep(preset="quick", seeds=2, root=tmp_path)
+    out = tmp_path / "sweep-quick"
+    data = json.loads((out / "results.json").read_text())
+    assert data["seeds"] == 2
+    aggs = data["aggregates"]
+    assert set(aggs) == {"q4-iid", "q4-dp"}
+    for a in aggs.values():
+        assert a["n_seeds"] == 2
+        assert 0.0 <= a["accuracy_mean"] <= 1.0 and a["accuracy_std"] >= 0.0
+        assert a["comm_mb_per_round"] > 0
+    assert aggs["q4-dp"]["epsilon_mean"] > 0  # DP cell tracked ε
+    md = (out / "results.md").read_text()
+    assert "q4-dp" in md and "±" in md
+    assert (out / "accuracy_vs_epsilon.png").exists()  # DP cell present
+    assert result["dir"] == str(out)
