@@ -1,0 +1,98 @@
+"""Measure the fused whole-circuit kernel vs the per-gate XLA path on TPU.
+
+Usage: python benchmarks/fused_sweep.py [n_qubits ...]
+Prints one JSON line per config: fwd+grad seconds per step for the
+default path, QFEDX_PALLAS=1 (per-gate kernel) and QFEDX_FUSED=1 (whole-
+circuit kernel), with speedups. This is the data behind the fused
+routing default (ops.fused_hea.AUTO_MIN_QUBITS).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_step(n_qubits, n_layers, batch, steps=8):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    model = make_vqc_classifier(n_qubits=n_qubits, n_layers=n_layers, num_classes=2)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 1, (batch, n_qubits)), dtype=jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, (batch,)), dtype=jnp.int32)
+
+    def loss(p):
+        logits = model.apply(p, x)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    @jax.jit
+    def many_steps(params):
+        def body(p, _):
+            l, g = jax.value_and_grad(loss)(p)
+            p2 = jax.tree.map(lambda a, b: a - 1e-6 * b, p, g)
+            return p2, l
+
+        return jax.lax.scan(body, params, None, length=steps)
+
+    return many_steps, params, steps
+
+
+def timeit(n_qubits, n_layers=3, batch=64, reps=5):
+    import jax
+
+    fn, params, steps = build_step(n_qubits, n_layers, batch)
+    _, ls = fn(params)
+    jax.block_until_ready(ls)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _, ls = fn(params)
+        jax.block_until_ready(ls)
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2] / steps
+
+
+def with_env(var, val, fn, *a):
+    prev = os.environ.get(var)
+    os.environ[var] = val
+    try:
+        return fn(*a)
+    finally:
+        if prev is None:
+            os.environ.pop(var, None)
+        else:
+            os.environ[var] = prev
+
+
+def main():
+    qubits = [int(a) for a in sys.argv[1:]] or [12, 14, 16, 18]
+    for n in qubits:
+        row = {"n_qubits": n, "n_layers": 3, "batch": 64}
+        try:
+            row["xla_s"] = round(with_env("QFEDX_FUSED", "0", timeit, n), 5)
+            row["pallas_gate_s"] = round(
+                with_env("QFEDX_PALLAS", "1",
+                         lambda m: with_env("QFEDX_FUSED", "0", timeit, m), n),
+                5,
+            )
+            row["fused_s"] = round(with_env("QFEDX_FUSED", "1", timeit, n), 5)
+            row["fused_speedup_vs_xla"] = round(row["xla_s"] / row["fused_s"], 3)
+            row["fused_speedup_vs_pallas_gate"] = round(
+                row["pallas_gate_s"] / row["fused_s"], 3
+            )
+        except Exception as e:  # noqa: BLE001 — report per-config
+            row["error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,3 +1,20 @@
-from qfedx_tpu.run.cli import main
+"""``python -m qfedx_tpu`` entry.
+
+The platform request must be honored BEFORE any qfedx_tpu import: the
+gate library materializes jnp constants at import time, which initializes
+the jax backend — after that, a sitecustomize-preselected TPU platform
+can no longer be switched away from (e.g. ``JAX_PLATFORMS=cpu`` for the
+8-device virtual host mesh that tests and CPU sweeps use).
+"""
+
+import os
+
+_want = os.environ.get("JAX_PLATFORMS")
+if _want:
+    import jax
+
+    jax.config.update("jax_platforms", _want)
+
+from qfedx_tpu.run.cli import main  # noqa: E402
 
 main()

@@ -41,6 +41,12 @@ def wrap_angle(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.mod(x + jnp.pi, 2 * jnp.pi) - jnp.pi
 
 
+def _fused_route(n_qubits: int) -> bool:
+    from qfedx_tpu.ops.fused_hea import fused_enabled
+
+    return fused_enabled(n_qubits)
+
+
 def make_vqc_classifier(
     n_qubits: int,
     n_layers: int = 2,
@@ -113,7 +119,37 @@ def make_vqc_classifier(
         if circuit_noise:
             eval_noise = eval_noise.composed(n_layers)
 
+    # Fused whole-circuit kernel (ops.fused_hea): the plain angle-encoded
+    # HEA forward+backward as ONE VMEM-resident Pallas program instead of
+    # ~2·L·n HBM passes. Exact same circuit, so it is a pure performance
+    # routing. The decision is made lazily at first apply (not at model
+    # build) because the auto-route probes the backend platform — doing
+    # that at build time would initialize the backend as a side effect,
+    # pinning the platform before callers could select one.
+    fused_candidate = (
+        encoding == "angle" and basis == "ry" and noise_model is None
+    )
+    _fused_cell: list = []
+
+    def _use_fused() -> bool:
+        if not fused_candidate:
+            return False
+        if not _fused_cell:
+            _fused_cell.append(_fused_route(n_qubits))
+        return _fused_cell[0]
+
     def apply(params, x):
+        if _use_fused():
+            from qfedx_tpu.ops.fused_hea import hea_zexp
+
+            enc = jax.vmap(lambda xi: angle_encode(xi, basis).re.reshape(-1))(x)
+            zexp = hea_zexp(
+                params["ansatz"]["rx"], params["ansatz"]["rz"], enc,
+                n_qubits, n_layers,
+            )
+            z = zexp[:, : params["readout"]["scale"].shape[0]]
+            return params["readout"]["scale"] * z + params["readout"]["bias"]
+
         def one(xi):
             state = forward_state(params, xi)
             if eval_noise is not None:

@@ -1,0 +1,534 @@
+"""Fused whole-circuit Pallas kernel for the hardware-efficient VQC.
+
+Statevector gate application is ~1 FLOP/byte, so the per-gate engine
+(ops.statevector, even with the per-gate Pallas kernel in
+ops.pallas_gates) is HBM-bound: every gate streams the full 2^n state
+from HBM and back — ~2·L·n round trips per forward. This kernel fuses
+the ENTIRE circuit — angle-encoded product state in, ⟨Z_k⟩ readout out —
+into one `pallas_call` that keeps the state resident in VMEM across all
+gates: HBM traffic drops from O(gates) state passes to O(1).
+
+Layout per sample: flat amplitude index (row-major over the (2,)*n state,
+qubit k ↔ bit n−1−k) is split as (row, lane) = (top n−7 bits, low 7 bits).
+The state lives in VMEM as a real pair of (BB, 2^{n−7}, 128) f32 slabs
+(BB = samples per grid step):
+
+- gates on ROW qubits (q ≤ n−8) are sublane-dim reshape/arithmetic — VPU;
+- gates on LANE qubits (q ≥ n−7) act inside the 128-lane dim, where TPU
+  vector registers cannot be cheaply shuffled — so they are expressed as
+  (…,128)×(128,128) matmuls against small structured matrices built
+  in-kernel from `broadcasted_iota` bit masks — MXU. A 128×128 matmul is
+  ~20× the FLOPs of the 2×2 contraction it implements, but those FLOPs
+  come from the otherwise-idle MXU while the op stays VMEM-resident.
+
+Backward is the textbook **adjoint method** (reference ROADMAP.md:23's
+"adjoint differentiation", the O(1)-memory alternative to taping every
+intermediate state): starting from the forward's final state ψ and the
+readout cotangent, sweep the circuit in reverse — ψ ← U†ψ (uncompute),
+accumulate dθ = λᵀ(∂U/∂θ)ψ via per-qubit 2×2 reduction matrices, and
+λ ← U†λ — again entirely in VMEM, in the same single HBM pass.
+
+Scope: the angle-encoded (real product state) hardware-efficient circuit
+of models.vqc — encoder → L × [rot_zx per qubit + CNOT ring] → ⟨Z_k⟩ —
+with 8 ≤ n ≤ 18 (n ≥ 8 so a full 128-lane dim exists; n ≤ 18 so the
+working set fits VMEM). Everything else falls back to the per-gate
+engine. Routing: `fused_enabled()` (QFEDX_FUSED=1 forces on, =0 forces
+off; unset → on-TPU auto for n ≥ AUTO_MIN_QUBITS, where fusion is the
+difference between HBM-bound and VMEM-resident).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+LANE_QUBITS = 7  # 2^7 = 128
+MIN_QUBITS = 8
+MAX_QUBITS = 18
+AUTO_MIN_QUBITS = 12
+
+_INTERPRET = False  # flipped by tests on CPU
+
+
+# --------------------------------------------------------------------------
+# In-kernel gate helpers. All operate on (x, y) = (re, im) value arrays of
+# shape (BB, R, 128) with R = 2^{n-7}; `n` and qubit indices are static
+# Python ints (the circuit structure is unrolled at trace time); gate
+# entries are traced scalars read from SMEM.
+# --------------------------------------------------------------------------
+
+
+def _row_bitpos(n: int, q: int) -> int:
+    """Bit position of row-qubit q inside the row index (qubit 0 = MSB)."""
+    return (n - LANE_QUBITS) - 1 - q
+
+
+def _lane_bitpos(n: int, q: int) -> int:
+    """Bit position of lane-qubit q inside the 7-bit lane index."""
+    return n - 1 - q
+
+
+def _lane_iota2d():
+    """(128,128) int32 iotas: rows index dim0 (input j), cols dim1 (out l)."""
+    j = jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 0)
+    l = jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 1)
+    return j, l
+
+
+def _lane_gate_matrix(p: int, u00, u01, u10, u11):
+    """(128,128) Mt with out = in @ Mt applying 2×2 [[u00,u01],[u10,u11]]
+    on lane bit p: Mt[j,l] = U[bit_l(p), bit_j(p)] when all other bits of
+    j and l agree, else 0. Entries are traced scalars (f32)."""
+    j, l = _lane_iota2d()
+    mask = 1 << p
+    other_ok = ((j ^ l) & (LANES - 1 - mask)) == 0
+    bj = (j >> p) & 1  # input (column of U)
+    bl = (l >> p) & 1  # output (row of U)
+    val = jnp.where(
+        bl == 0,
+        jnp.where(bj == 0, u00, u01),
+        jnp.where(bj == 0, u10, u11),
+    )
+    zero = jnp.zeros((), dtype=jnp.float32)
+    return jnp.where(other_ok, val, zero)
+
+
+def _lane_perm_flip(p: int):
+    """(128,128) permutation P (symmetric): lane l ← lane l ^ (1<<p)."""
+    j, l = _lane_iota2d()
+    return jnp.where(j == (l ^ (1 << p)), 1.0, 0.0).astype(jnp.float32)
+
+
+def _lane_perm_cnot(pc: int, pt: int):
+    """(128,128) Mt for CNOT with control bit pc, target bit pt (lanes)."""
+    j, l = _lane_iota2d()
+    ctrl1 = ((j >> pc) & 1) == 1
+    tgt = jnp.where(ctrl1, j ^ (1 << pt), j)
+    return jnp.where(l == tgt, 1.0, 0.0).astype(jnp.float32)
+
+
+def _matmul_lanes(x, m):
+    """(..., 128) @ (128, 128) on the MXU, f32 accumulate."""
+    shape = x.shape
+    out = jax.lax.dot_general(
+        x.reshape(-1, LANES),
+        m,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(shape)
+
+
+def _rot_entries(theta, phi):
+    """rot_zx = RZ(φ)·RX(θ) real/imag 2×2 entries (ops.gates.rot_zx)."""
+    c, s = jnp.cos(theta * 0.5), jnp.sin(theta * 0.5)
+    a, b = jnp.cos(phi * 0.5), jnp.sin(phi * 0.5)
+    ur = (a * c, -b * s, b * s, a * c)
+    ui = (-b * c, -a * s, -a * s, b * c)
+    return ur, ui
+
+
+def _rot_entries_adjoint(theta, phi):
+    """rot_zx† = conj-transpose entries."""
+    c, s = jnp.cos(theta * 0.5), jnp.sin(theta * 0.5)
+    a, b = jnp.cos(phi * 0.5), jnp.sin(phi * 0.5)
+    ur = (a * c, b * s, -b * s, a * c)
+    ui = (b * c, a * s, a * s, -b * c)
+    return ur, ui
+
+
+def _rot_derivs(theta, phi):
+    """(dU/dθ, dU/dφ) entries of rot_zx, each ((re 2×2), (im 2×2))."""
+    c, s = jnp.cos(theta * 0.5), jnp.sin(theta * 0.5)
+    a, b = jnp.cos(phi * 0.5), jnp.sin(phi * 0.5)
+    h = 0.5
+    dth = (
+        (-a * s * h, -b * c * h, b * c * h, -a * s * h),
+        (b * s * h, -a * c * h, -a * c * h, -b * s * h),
+    )
+    dph = (
+        (-b * c * h, -a * s * h, a * s * h, -b * c * h),
+        (-a * c * h, b * s * h, b * s * h, a * c * h),
+    )
+    return dth, dph
+
+
+def _split_row(x, n: int, q: int):
+    """(BB, R, 128) → (BB, A, 2, C, 128) split at row-qubit q."""
+    bb = x.shape[0]
+    a = 1 << q
+    c = 1 << _row_bitpos(n, q)
+    return x.reshape(bb, a, 2, c, LANES)
+
+
+def _join_row(x0, x1, axis: int = 2):
+    """Inverse of _split_row halves: stack and flatten back to (BB,R,128)."""
+    out = jnp.stack([x0, x1], axis=axis)
+    bb = out.shape[0]
+    return out.reshape(bb, -1, LANES)
+
+
+def _apply_rot(x, y, n: int, q: int, ur, ui):
+    """Complex 2×2 [[u00,u01],[u10,u11]] on qubit q."""
+    u00r, u01r, u10r, u11r = ur
+    u00i, u01i, u10i, u11i = ui
+    if q <= n - LANE_QUBITS - 1:  # row qubit — VPU
+        xs, ys = _split_row(x, n, q), _split_row(y, n, q)
+        x0, x1 = xs[:, :, 0], xs[:, :, 1]
+        y0, y1 = ys[:, :, 0], ys[:, :, 1]
+        nx0 = u00r * x0 + u01r * x1 - u00i * y0 - u01i * y1
+        ny0 = u00r * y0 + u01r * y1 + u00i * x0 + u01i * x1
+        nx1 = u10r * x0 + u11r * x1 - u10i * y0 - u11i * y1
+        ny1 = u10r * y0 + u11r * y1 + u10i * x0 + u11i * x1
+        return _join_row(nx0, nx1), _join_row(ny0, ny1)
+    # lane qubit — MXU
+    p = _lane_bitpos(n, q)
+    mr = _lane_gate_matrix(p, u00r, u01r, u10r, u11r)
+    mi = _lane_gate_matrix(p, u00i, u01i, u10i, u11i)
+    xr, xi_ = _matmul_lanes(x, mr), _matmul_lanes(x, mi)
+    yr, yi_ = _matmul_lanes(y, mr), _matmul_lanes(y, mi)
+    return xr - yi_, yr + xi_
+
+
+def _apply_cnot_one(x, n: int, c: int, t: int):
+    """CNOT (control c → target t) on one real slab. Self-inverse."""
+    nrow = n - LANE_QUBITS
+    c_row, t_row = c < nrow, t < nrow
+    if c_row and t_row:
+        lo, hi = (c, t) if c < t else (t, c)
+        bb = x.shape[0]
+        a = 1 << lo
+        m = 1 << (hi - lo - 1)
+        cc = 1 << _row_bitpos(n, hi)
+        xs = x.reshape(bb, a, 2, m, 2, cc, LANES)
+        if c < t:  # control is the outer bit
+            x1 = xs[:, :, 1]  # (BB, A, M, 2, C, L)
+            x1sw = jnp.stack([x1[:, :, :, 1], x1[:, :, :, 0]], axis=3)
+            out = jnp.stack([xs[:, :, 0], x1sw], axis=2)
+        else:  # control is the inner bit: swap outer halves where inner=1
+            o0, o1 = xs[:, :, 0], xs[:, :, 1]  # (BB, A, M, 2, C, L)
+            n0 = jnp.stack([o0[:, :, :, 0], o1[:, :, :, 1]], axis=3)
+            n1 = jnp.stack([o1[:, :, :, 0], o0[:, :, :, 1]], axis=3)
+            out = jnp.stack([n0, n1], axis=2)
+        return out.reshape(bb, -1, LANES)
+    if c_row and not t_row:  # control row, target lane: flip lanes where c=1
+        xs = _split_row(x, n, c)
+        pf = _lane_perm_flip(_lane_bitpos(n, t))
+        return _join_row(xs[:, :, 0], _matmul_lanes(xs[:, :, 1], pf))
+    if (not c_row) and t_row:  # control lane, target row: per-lane select
+        pc = _lane_bitpos(n, c)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, 1, LANES), 2)
+        m = (((lane >> pc) & 1) == 1)
+        xs = _split_row(x, n, t)
+        x0, x1 = xs[:, :, 0], xs[:, :, 1]
+        return _join_row(jnp.where(m, x1, x0), jnp.where(m, x0, x1))
+    # both lanes
+    mt = _lane_perm_cnot(_lane_bitpos(n, c), _lane_bitpos(n, t))
+    return _matmul_lanes(x, mt)
+
+
+def _apply_cnot(x, y, n: int, c: int, t: int):
+    return _apply_cnot_one(x, n, c, t), _apply_cnot_one(y, n, c, t)
+
+
+def _entangle_ring(x, y, n: int):
+    """Matches circuits.ansatz._entangle_ring order exactly."""
+    for q in range(n - 1):
+        x, y = _apply_cnot(x, y, n, q, q + 1)
+    if n > 2:
+        x, y = _apply_cnot(x, y, n, n - 1, 0)
+    return x, y
+
+
+def _entangle_ring_reverse(x, y, n: int):
+    if n > 2:
+        x, y = _apply_cnot(x, y, n, n - 1, 0)
+    for q in reversed(range(n - 1)):
+        x, y = _apply_cnot(x, y, n, q, q + 1)
+    return x, y
+
+
+def _z_signs(n: int, q: int, r: int):
+    """±1 sign array broadcastable against (BB, R, 128) for ⟨Z_q⟩."""
+    if q <= n - LANE_QUBITS - 1:
+        rbit = (
+            jax.lax.broadcasted_iota(jnp.int32, (1, r, LANES), 1)
+            >> _row_bitpos(n, q)
+        ) & 1
+        return (1 - 2 * rbit).astype(jnp.float32)
+    lbit = (
+        jax.lax.broadcasted_iota(jnp.int32, (1, r, LANES), 2)
+        >> _lane_bitpos(n, q)
+    ) & 1
+    return (1 - 2 * lbit).astype(jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# Forward kernel
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(n: int, n_layers: int, save_state: bool,
+                rx_ref, rz_ref, enc_ref, zexp_ref, xf_ref=None, yf_ref=None):
+    x = enc_ref[...]
+    y = jnp.zeros_like(x)
+    for layer in range(n_layers):
+        for q in range(n):
+            ur, ui = _rot_entries(rx_ref[layer, q], rz_ref[layer, q])
+            x, y = _apply_rot(x, y, n, q, ur, ui)
+        x, y = _entangle_ring(x, y, n)
+    probs = x * x + y * y
+    r = x.shape[1]
+    cols = [jnp.sum(probs * _z_signs(n, q, r), axis=(1, 2)) for q in range(n)]
+    zexp_ref[...] = jnp.stack(cols, axis=1)
+    if save_state:
+        xf_ref[...] = x
+        yf_ref[...] = y
+
+
+# --------------------------------------------------------------------------
+# Backward kernel (adjoint method)
+# --------------------------------------------------------------------------
+
+
+def _w_matrices(n: int, q: int, lx, ly, px, py):
+    """2×2 reduction matrices between cotangent λ and state ψ on qubit q:
+
+        Wrr[a,b] = Σ λx_a·ψx_b + λy_a·ψy_b
+        Wri[a,b] = Σ λy_a·ψx_b − λx_a·ψy_b
+
+    so that dθ = Σ_ab dUr[a,b]·Wrr[a,b] + dUi[a,b]·Wri[a,b] — the VJP of
+    a complex 2×2 gate through the real-pair linear map, reduced over
+    batch and all non-target amplitudes."""
+    if q <= n - LANE_QUBITS - 1:
+        lxs, lys = _split_row(lx, n, q), _split_row(ly, n, q)
+        pxs, pys = _split_row(px, n, q), _split_row(py, n, q)
+        wrr = [[None, None], [None, None]]
+        wri = [[None, None], [None, None]]
+        for a_ in range(2):
+            for b_ in range(2):
+                la_x, la_y = lxs[:, :, a_], lys[:, :, a_]
+                pb_x, pb_y = pxs[:, :, b_], pys[:, :, b_]
+                wrr[a_][b_] = jnp.sum(la_x * pb_x + la_y * pb_y)
+                wri[a_][b_] = jnp.sum(la_y * pb_x - la_x * pb_y)
+        return wrr, wri
+    p = _lane_bitpos(n, q)
+    pf = _lane_perm_flip(p)
+    fx, fy = _matmul_lanes(px, pf), _matmul_lanes(py, pf)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, 1, LANES), 2)
+    masks = [
+        (((lane >> p) & 1) == a_).astype(jnp.float32) for a_ in range(2)
+    ]
+    wrr = [[None, None], [None, None]]
+    wri = [[None, None], [None, None]]
+    for a_ in range(2):
+        m = masks[a_]
+        for b_ in range(2):
+            # ψ_b aligned to λ_a's lanes: ψ itself when b==a, else flipped.
+            qx, qy = (px, py) if a_ == b_ else (fx, fy)
+            wrr[a_][b_] = jnp.sum(m * (lx * qx + ly * qy))
+            wri[a_][b_] = jnp.sum(m * (ly * qx - lx * qy))
+    return wrr, wri
+
+
+def _contract_w(d_entries, wrr, wri):
+    dr, di = d_entries
+    d00r, d01r, d10r, d11r = dr
+    d00i, d01i, d10i, d11i = di
+    return (
+        d00r * wrr[0][0] + d01r * wrr[0][1] + d10r * wrr[1][0] + d11r * wrr[1][1]
+        + d00i * wri[0][0] + d01i * wri[0][1] + d10i * wri[1][0] + d11i * wri[1][1]
+    )
+
+
+def _bwd_kernel(n: int, n_layers: int,
+                rx_ref, rz_ref, xf_ref, yf_ref, ct_ref, drx_ref, drz_ref):
+    x = xf_ref[...]
+    y = yf_ref[...]
+    ct = ct_ref[...]  # (BB, n)
+    bb, r = x.shape[0], x.shape[1]
+
+    # λ = ∂(Σ_k ct_k ⟨Z_k⟩)/∂ψ = 2·S∘ψ with S = Σ_k ct_k σ_k (diagonal).
+    s = jnp.zeros_like(x)
+    for q in range(n):
+        s = s + ct[:, q].reshape(bb, 1, 1) * _z_signs(n, q, r)
+    lx, ly = 2.0 * s * x, 2.0 * s * y
+
+    drx_cols: list[list] = [[None] * n for _ in range(n_layers)]
+    drz_cols: list[list] = [[None] * n for _ in range(n_layers)]
+    for layer in reversed(range(n_layers)):
+        x, y = _entangle_ring_reverse(x, y, n)
+        lx, ly = _entangle_ring_reverse(lx, ly, n)
+        for q in reversed(range(n)):
+            theta, phi = rx_ref[layer, q], rz_ref[layer, q]
+            ur, ui = _rot_entries_adjoint(theta, phi)
+            x, y = _apply_rot(x, y, n, q, ur, ui)  # ψ_pre (uncompute)
+            wrr, wri = _w_matrices(n, q, lx, ly, x, y)
+            dth, dph = _rot_derivs(theta, phi)
+            drx_cols[layer][q] = _contract_w(dth, wrr, wri)
+            drz_cols[layer][q] = _contract_w(dph, wrr, wri)
+            lx, ly = _apply_rot(lx, ly, n, q, ur, ui)  # λ ← U†λ
+
+    drx = jnp.stack([jnp.stack(row) for row in drx_cols])
+    drz = jnp.stack([jnp.stack(row) for row in drz_cols])
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        drx_ref[...] = drx
+        drz_ref[...] = drz
+
+    @pl.when(pl.program_id(0) != 0)
+    def _acc():
+        drx_ref[...] += drx
+        drz_ref[...] += drz
+
+
+# --------------------------------------------------------------------------
+# Host-side wrappers
+# --------------------------------------------------------------------------
+
+
+def _block_batch(n: int, batch: int) -> int:
+    """Samples per grid step: keep x+y ≈ ≤2MB so the working set (state,
+    λ, pipeline buffers) stays well inside the ~16MB scoped VMEM — and
+    never larger than the (power-of-two-rounded) real batch, so small
+    batches aren't zero-padded up to the VMEM budget."""
+    bb = int(os.environ.get("QFEDX_FUSED_BB", "0"))
+    if bb <= 0:
+        bb = max(1, 1 << max(0, 17 - n))
+    cap = 1
+    while cap < batch:
+        cap <<= 1
+    return min(bb, cap)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def hea_zexp(rx: jnp.ndarray, rz: jnp.ndarray, enc: jnp.ndarray,
+             n_qubits: int, n_layers: int) -> jnp.ndarray:
+    """⟨Z_k⟩ for all k of the angle-encoded HEA circuit, fused.
+
+    rx, rz: (L, n) rotation angles. enc: (B, 2^n) REAL encoded state
+    (angle encoding yields a real product state). Returns (B, n).
+
+    Differentiable in (rx, rz) via the fused adjoint backward; ``enc`` is
+    treated as data (its cotangent is zero) — callers must not route
+    trainable parameters through it (models.vqc only uses this path for
+    the plain angle encoder, where enc depends on inputs only).
+    """
+    # Undifferentiated primal (evaluation): forward-only kernel, no
+    # final-state residuals written to HBM. The VJP forward (_hea_fwd)
+    # runs the save_state variant instead.
+    (zexp,) = _fwd_call(rx, rz, enc, n_qubits, n_layers, save_state=False)
+    return zexp
+
+
+def _pad_batch(enc: jnp.ndarray, bb: int) -> jnp.ndarray:
+    b = enc.shape[0]
+    pad = (-b) % bb
+    if pad:
+        enc = jnp.concatenate(
+            [enc, jnp.zeros((pad,) + enc.shape[1:], enc.dtype)], axis=0
+        )
+    return enc
+
+
+def _fwd_call(rx, rz, enc, n_qubits: int, n_layers: int, save_state: bool):
+    n, el = n_qubits, n_layers
+    b = enc.shape[0]
+    r = 1 << (n - LANE_QUBITS)
+    bb = _block_batch(n, b)
+    encp = _pad_batch(enc, bb).reshape(-1, r, LANES)
+    bp = encp.shape[0]
+    grid = (bp // bb,)
+    kernel = functools.partial(_fwd_kernel, n, el, save_state)
+    smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
+    slab = lambda: pl.BlockSpec((bb, r, LANES), lambda i: (i, 0, 0))
+    zspec = pl.BlockSpec((bb, n), lambda i: (i, 0))
+    zshape = jax.ShapeDtypeStruct((bp, n), jnp.float32)
+    sshape = jax.ShapeDtypeStruct((bp, r, LANES), jnp.float32)
+    out_specs = [zspec] + ([slab(), slab()] if save_state else [])
+    out_shape = [zshape] + ([sshape, sshape] if save_state else [])
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[smem(), smem(), slab()],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=_INTERPRET,
+    )(rx, rz, encp)
+    return (outs[0][:b],) + tuple(outs[1:])
+
+
+def _hea_fwd(rx, rz, enc, n_qubits, n_layers):
+    zexp, xf, yf = _fwd_call(rx, rz, enc, n_qubits, n_layers, save_state=True)
+    return zexp, (rx, rz, xf, yf)
+
+
+def _hea_bwd(n_qubits, n_layers, res, ct):
+    rx, rz, xf, yf = res
+    n, el = n_qubits, n_layers
+    r = 1 << (n - LANE_QUBITS)
+    bp = xf.shape[0]
+    bb = _block_batch(n, bp)
+    ctp = _pad_batch(ct, bb)  # zero cotangent for padded samples
+    grid = (bp // bb,)
+    kernel = functools.partial(_bwd_kernel, n, el)
+    smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
+    slab = lambda: pl.BlockSpec((bb, r, LANES), lambda i: (i, 0, 0))
+    acc = lambda: pl.BlockSpec((el, n), lambda i: (0, 0))
+    drx, drz = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[smem(), smem(), slab(), slab(),
+                  pl.BlockSpec((bb, n), lambda i: (i, 0))],
+        out_specs=[acc(), acc()],
+        out_shape=[
+            jax.ShapeDtypeStruct((el, n), jnp.float32),
+            jax.ShapeDtypeStruct((el, n), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(rx, rz, xf, yf, ctp)
+    # enc is data, not parameters (documented in hea_zexp): zero cotangent.
+    denc = jnp.zeros((ct.shape[0], 1 << n), jnp.float32)
+    return drx, drz, denc
+
+
+hea_zexp.defvjp(_hea_fwd, _hea_bwd)
+
+
+# --------------------------------------------------------------------------
+# Routing
+# --------------------------------------------------------------------------
+
+
+def fused_eligible(n_qubits: int) -> bool:
+    return MIN_QUBITS <= n_qubits <= MAX_QUBITS
+
+
+def fused_enabled(n_qubits: int) -> bool:
+    """QFEDX_FUSED=1 forces on (for eligible n), =0 forces off; unset →
+    auto: on for TPU backends at n ≥ AUTO_MIN_QUBITS, where the per-gate
+    path is HBM-bound and fusion pays; small circuits are dispatch-bound
+    and stay on the (also known-real-optimized) XLA path."""
+    if not fused_eligible(n_qubits):
+        return False
+    flag = os.environ.get("QFEDX_FUSED")
+    if flag == "1":
+        return True
+    if flag == "0":
+        return False
+    if n_qubits < AUTO_MIN_QUBITS:
+        return False
+    # NOTE: jax.devices() initializes the backend — callers (models.vqc)
+    # defer this probe to first Model.apply, where a backend is needed
+    # anyway, so the auto-route never pins the platform early.
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001 — unusable backend: stay on XLA path
+        return False

@@ -258,6 +258,10 @@ def jax_profiler_trace(log_dir):
 
 
 def main(argv=None):
+    # NOTE: JAX_PLATFORMS is honored in qfedx_tpu/__main__.py, BEFORE any
+    # qfedx_tpu import can initialize the backend (the gate library builds
+    # jnp constants at import time). Nothing platform-related can be done
+    # this late.
     args = build_parser().parse_args(argv)
     if args.cmd == "train":
         cfg = config_from_args(args)

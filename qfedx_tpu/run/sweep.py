@@ -55,25 +55,34 @@ def preset_cells(preset: str) -> list[dict]:
         ]
     if preset == "roadmap":
         # ROADMAP.md:105-107 grid: qubits × α (non-IID skew) × p (sampling).
+        # Every cell runs the SAME binary task (0 vs 1): the 2-qubit cell
+        # can only read out 2 classes (one ⟨Z⟩ logit per qubit), and the
+        # whole grid must share one task for its cells — the width axis,
+        # the α/p columns vs the iid baseline — to be comparable.
         cells = []
+        bi = {"classes": (0, 1)}
         for q in (2, 4, 8):
-            cells.append(_cell(f"q{q}-iid", qubits=q, clients=8))
+            cells.append(_cell(f"q{q}-iid", qubits=q, clients=8, **bi))
         for alpha in (0.1, 0.3, 1.0):
             cells.append(
                 _cell(f"q4-a{alpha}", qubits=4, clients=8,
-                      partition="dirichlet", alpha=alpha)
+                      partition="dirichlet", alpha=alpha, **bi)
             )
         for p in (0.1, 0.3, 1.0):
-            cells.append(_cell(f"q4-p{p}", qubits=4, clients=8, client_fraction=p))
+            cells.append(
+                _cell(f"q4-p{p}", qubits=4, clients=8, client_fraction=p, **bi)
+            )
         for sigma in (0.5, 1.0, 2.0):
             cells.append(
                 _cell(f"q4-dp{sigma}", qubits=4, clients=8,
-                      dp_sigma=sigma, dp_clip=1.0)
+                      dp_sigma=sigma, dp_clip=1.0, **bi)
             )
         # Scaling axis: SAME model/config, ONLY the cohort size varies —
         # the one comparison the speedup-vs-clients plot may draw from.
         for c in (2, 8, 32):
-            cells.append(_cell(f"q4-c{c}", qubits=4, clients=c, scaling=True))
+            cells.append(
+                _cell(f"q4-c{c}", qubits=4, clients=c, scaling=True, **bi)
+            )
         return cells
     if preset == "baseline":
         # BASELINE.md configs 1–5 at harness scale (client counts kept true;

@@ -1,0 +1,127 @@
+"""Fused whole-circuit kernel vs the per-gate dense engine (interpret mode).
+
+The fused kernel (ops.fused_hea) must be a pure performance routing: the
+same circuit — angle encoding → L × [rot_zx + CNOT ring] → ⟨Z_k⟩ — so
+forward values AND gradients must match the tensordot engine that the
+rest of the framework (and these tests' oracle) uses.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import qfedx_tpu.ops.fused_hea as fh
+from qfedx_tpu.circuits.ansatz import hardware_efficient, init_ansatz_params
+from qfedx_tpu.circuits.encoders import angle_encode
+from qfedx_tpu.ops.statevector import expect_z_all
+
+
+@pytest.fixture(autouse=True)
+def interpret_mode():
+    old = fh._INTERPRET
+    fh._INTERPRET = True  # no TPU in the test environment
+    yield
+    fh._INTERPRET = old
+
+
+def _dense_zexp(rx, rz, x):
+    """Oracle: per-gate engine, identical circuit."""
+
+    def one(xi):
+        state = hardware_efficient(angle_encode(xi), {"rx": rx, "rz": rz})
+        return expect_z_all(state)
+
+    return jax.vmap(one)(x)
+
+
+def _fused_zexp(rx, rz, x, n, layers):
+    enc = jax.vmap(lambda xi: angle_encode(xi).re.reshape(-1))(x)
+    return fh.hea_zexp(rx, rz, enc, n, layers)
+
+
+def _setup(n, layers, batch, seed=0):
+    params = init_ansatz_params(jax.random.PRNGKey(seed), n, layers, scale=0.7)
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.uniform(0, 1, (batch, n)), dtype=jnp.float32)
+    return params["rx"], params["rz"], x
+
+
+# n=8 puts every qubit except qubit 0 in the lane dim (R=2 rows); n=10
+# exercises a real row/lane mix (and ragged batch → padding path).
+@pytest.mark.parametrize("n,layers,batch", [(8, 2, 4), (10, 3, 5)])
+def test_forward_matches_dense(n, layers, batch):
+    rx, rz, x = _setup(n, layers, batch)
+    got = _fused_zexp(rx, rz, x, n, layers)
+    want = _dense_zexp(rx, rz, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+@pytest.mark.parametrize("n,layers,batch", [(8, 2, 3), (10, 2, 4)])
+def test_gradients_match_dense(n, layers, batch):
+    """Fused adjoint backward ≡ jax.grad through the per-gate engine."""
+    rx, rz, x = _setup(n, layers, batch, seed=1)
+    w = jnp.asarray(
+        np.random.default_rng(2).normal(size=(batch, n)), dtype=jnp.float32
+    )
+
+    def loss_fused(rx_, rz_):
+        return jnp.sum(w * _fused_zexp(rx_, rz_, x, n, layers))
+
+    def loss_dense(rx_, rz_):
+        return jnp.sum(w * _dense_zexp(rx_, rz_, x))
+
+    np.testing.assert_allclose(
+        float(loss_fused(rx, rz)), float(loss_dense(rx, rz)), atol=1e-5
+    )
+    gf = jax.grad(loss_fused, argnums=(0, 1))(rx, rz)
+    gd = jax.grad(loss_dense, argnums=(0, 1))(rx, rz)
+    np.testing.assert_allclose(np.asarray(gf[0]), np.asarray(gd[0]), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gf[1]), np.asarray(gd[1]), atol=2e-4)
+
+
+def test_model_fused_path_matches_default(monkeypatch):
+    """make_vqc_classifier with QFEDX_FUSED=1 ≡ the default path, end to
+    end through the Model.apply contract (logits, not just ⟨Z⟩)."""
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    n, layers, batch = 8, 2, 6
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.uniform(0, 1, (batch, n)), dtype=jnp.float32)
+
+    monkeypatch.delenv("QFEDX_FUSED", raising=False)
+    base = make_vqc_classifier(n_qubits=n, n_layers=layers, num_classes=2)
+    params = base.init(jax.random.PRNGKey(0))
+    want = base.apply(params, x)
+
+    monkeypatch.setenv("QFEDX_FUSED", "1")
+    fused = make_vqc_classifier(n_qubits=n, n_layers=layers, num_classes=2)
+    got = fused.apply(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_routing(monkeypatch):
+    monkeypatch.delenv("QFEDX_FUSED", raising=False)
+    assert not fh.fused_eligible(7)  # needs a full 128-lane dim
+    assert fh.fused_eligible(8)
+    assert fh.fused_eligible(18)
+    assert not fh.fused_eligible(19)  # VMEM working-set cap
+
+    class _Dev:
+        def __init__(self, platform):
+            self.platform = platform
+
+    # Auto route: TPU backend → on for n ≥ AUTO_MIN_QUBITS, never below.
+    monkeypatch.setattr(fh.jax, "devices", lambda: [_Dev("tpu")])
+    assert fh.fused_enabled(16)
+    assert not fh.fused_enabled(fh.AUTO_MIN_QUBITS - 1)
+    # Non-TPU backend, unset flag → off regardless of n.
+    monkeypatch.setattr(fh.jax, "devices", lambda: [_Dev("cpu")])
+    assert not fh.fused_enabled(16)
+
+    monkeypatch.setenv("QFEDX_FUSED", "1")
+    assert fh.fused_enabled(8)
+    assert not fh.fused_enabled(19)  # force cannot override eligibility
+    monkeypatch.setenv("QFEDX_FUSED", "0")
+    monkeypatch.setattr(fh.jax, "devices", lambda: [_Dev("tpu")])
+    assert not fh.fused_enabled(16)
