@@ -1,0 +1,90 @@
+"""MPS-simulated real-amplitudes VQC classifier — the >20-qubit model.
+
+The dense VQC (models.vqc) holds 2^n amplitudes per sample; past ~20
+qubits that is the wall the reference acknowledges (ROADMAP.md:86,
+pointing to tensor networks beyond it). This model simulates the circuit
+as an MPS (ops.mps): memory O(n·χ²), so 32-qubit classifiers train on a
+single chip — and it rides the SAME federated harness via the Model
+contract (models.api), like every other model family.
+
+Circuit (real-amplitudes family — everything stays real, which is what
+makes MPS TPU-native here, see ops.mps):
+
+    angle encoding RY(π·f_k) per qubit (product MPS)
+    L × [ RY(θ_{l,k}) per qubit  →  CNOT line entangler (k→k+1) ]
+    ⟨Z_k⟩ readout → scale·z + bias logits
+
+χ (``bond_dim``) is the accuracy/cost knob: χ ≥ 2^{n/2} is exact; small
+χ truncates entanglement after every CNOT (a *regularizer* in practice,
+and the only thing that makes n ≫ 20 tractable anywhere).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from qfedx_tpu.models.api import Model
+from qfedx_tpu.models.vqc import wrap_angle
+from qfedx_tpu.circuits.readout import init_readout_params
+from qfedx_tpu.ops import mps
+
+
+def _ry_mats(angles: jnp.ndarray) -> jnp.ndarray:
+    """(n,) angles → (n, 2, 2) RY matrices (real)."""
+    c, s = jnp.cos(angles / 2), jnp.sin(angles / 2)
+    row0 = jnp.stack([c, -s], axis=-1)
+    row1 = jnp.stack([s, c], axis=-1)
+    return jnp.stack([row0, row1], axis=-2)
+
+
+def make_mps_classifier(
+    n_qubits: int,
+    n_layers: int = 2,
+    num_classes: int = 2,
+    bond_dim: int = 16,
+    init_scale: float = 0.1,
+) -> Model:
+    """Build the MPS VQC Model. Inputs: (B, n_qubits) features in [0,1]."""
+    if num_classes > n_qubits:
+        raise ValueError(f"need n_qubits ≥ num_classes ({num_classes})")
+    if bond_dim < 2:
+        raise ValueError("bond_dim must be ≥ 2")
+
+    def init(key: jax.Array):
+        k1, k2 = jax.random.split(key)
+        return {
+            "ansatz": {
+                "ry": init_scale
+                * jax.random.normal(
+                    k1, (n_layers, n_qubits), dtype=jnp.float32
+                )
+            },
+            "readout": init_readout_params(k2, num_classes),
+        }
+
+    def forward_z(params, xi):
+        amps = _ry_mats(xi * jnp.pi)[:, :, 0]  # RY(πf)|0⟩ columns, (n, 2)
+        state = mps.product_mps(amps, bond_dim)
+        for layer in range(n_layers):
+            gs = _ry_mats(params["ansatz"]["ry"][layer])
+            state = mps.apply_1q_all(state, gs)
+            state = mps.apply_cnot_chain(state)
+        return mps.expect_z_all(state)
+
+    def apply(params, x):
+        z = jax.vmap(lambda xi: forward_z(params, xi))(x)[:, :num_classes]
+        return params["readout"]["scale"] * z + params["readout"]["bias"]
+
+    def wrap_delta(delta):
+        return {
+            "ansatz": {"ry": wrap_angle(delta["ansatz"]["ry"])},
+            "readout": delta["readout"],
+        }
+
+    return Model(
+        init=init,
+        apply=apply,
+        wrap_delta=wrap_delta,
+        name=f"mps{n_qubits}q{n_layers}l-chi{bond_dim}",
+    )

@@ -1,0 +1,86 @@
+"""Differentiable linear algebra helpers: SVD with a safe backward.
+
+The MPS engine (ops.mps) splits two-site tensors with an SVD after every
+entangling gate. Those matrices are *structurally* rank-deficient —
+e.g. a product state hit by a CNOT has exactly one nonzero singular
+value, and padded uniform bond dimensions contribute exact zeros — and
+JAX's stock `jnp.linalg.svd` VJP divides by both (s_i² − s_j²) and s_i,
+producing inf/NaN gradients at exactly the points every training run
+visits (small-angle init ≈ product states).
+
+`safe_svd` is the standard tensor-network-autodiff remedy (Lorentzian
+broadening, cf. differentiable-DMRG literature): the same reverse-mode
+formula with every singular inverse x⁻¹ replaced by x/(x²+ε). At
+well-separated spectra it agrees with the exact VJP to O(ε); at
+degeneracies it returns the finite, gauge-smoothed direction instead of
+NaN. Real f32 only — the MPS path simulates real-amplitude circuits
+(RY + CNOT), which is what makes TPU-native MPS clean: no complex dtype
+anywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def safe_svd(m: jnp.ndarray, eps: float = 1e-10):
+    """Thin SVD (U, S, Vh) of a real matrix with NaN-free gradients."""
+    return jnp.linalg.svd(m, full_matrices=False)
+
+
+def _safe_svd_fwd(m, eps):
+    out = jnp.linalg.svd(m, full_matrices=False)
+    return out, out
+
+
+def _safe_svd_bwd(eps, res, cts):
+    u, s, vh = res
+    du, ds, dvh = cts
+    v = vh.T
+    dv = dvh.T
+    k = s.shape[0]
+
+    s2 = s * s
+    # Broadened 1/(s_j² − s_i²): antisymmetric, zero diagonal.
+    diff = s2[None, :] - s2[:, None]
+    f = diff / (diff * diff + eps)
+    f = f - jnp.diag(jnp.diag(f))
+    # Broadened 1/s.
+    sinv = s / (s2 + eps)
+
+    utdu = u.T @ du
+    vtdv = v.T @ dv
+    su = f * (utdu - utdu.T)  # F ∘ (UᵀU̅ − U̅ᵀU)
+    sv = f * (vtdv - vtdv.T)
+
+    mid = su * s[None, :] + s[:, None] * sv + jnp.diag(ds)
+    dm = u @ mid @ vh
+
+    m_, p = u.shape[0], v.shape[0]
+    if m_ > k:  # column-space complement of U contributes
+        proj_u = jnp.eye(m_, dtype=u.dtype) - u @ u.T
+        dm = dm + proj_u @ du * sinv[None, :] @ vh
+    if p > k:  # row-space complement of V contributes
+        proj_v = jnp.eye(p, dtype=v.dtype) - v @ v.T
+        dm = dm + u * sinv[None, :] @ dv.T @ proj_v
+
+    return (dm,)
+
+
+safe_svd.defvjp(_safe_svd_fwd, _safe_svd_bwd)
+
+
+def truncated_svd(m: jnp.ndarray, chi: int, eps: float = 1e-10):
+    """safe_svd truncated to the top-``chi`` singular triples.
+
+    Returns (U[:, :chi], S[:chi], Vh[:chi, :]). ``chi`` is static; if the
+    matrix has fewer than chi singular values the caller's shapes must
+    already account for it (the MPS engine uses uniform padded bonds, so
+    chi always ≤ min(m.shape)).
+    """
+    u, s, vh = safe_svd(m, eps)
+    return u[:, :chi], s[:chi], vh[:chi, :]

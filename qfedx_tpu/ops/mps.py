@@ -1,0 +1,140 @@
+"""Matrix-product-state (MPS) simulator — past the dense 2^n wall.
+
+The reference caps dense statevector simulation at ~20 qubits and points
+to tensor-network methods beyond it (reference ROADMAP.md:86). This is
+that path, built TPU-first: an MPS is n small real tensors, every gate
+is a batched matmul-sized contraction (MXU food), memory is O(n·χ²)
+instead of O(2^n) — 32+ qubit circuits run where the dense engine would
+need 64 GB per state.
+
+Scope — real-amplitude circuits. TPU has no complex dtype (ops.cpx), and
+splitting a two-site tensor needs an SVD, which has no good complex-as-
+real-pair form. So the MPS path simulates the *real-amplitudes* circuit
+family: RY rotations + CNOT entangler chains on angle-encoded (RY)
+product states — everything stays in ℝ end to end. That family is the
+standard hardware-efficient QML ansatz in its own right (models.vqc_mps
+trains it federatedly on the same harness as the dense VQC).
+
+Representation: one f32 array of shape (n, χ, 2, χ) — site k holds
+A[k][l, s, r] with uniform (zero-padded) bond dimension χ; boundary
+bonds use index 0. Uniform bonds keep every shape static, so the whole
+circuit jits, vmaps over batches, and lowers to fixed-shape MXU matmuls.
+Truncation after each two-site gate uses ops.linalg.safe_svd — gradients
+stay finite at the structural rank deficiencies padding introduces.
+
+Gate order matches a line (open-boundary) entangler: CNOT (k→k+1) for
+k = 0..n−2. A ring's wrap gate (n−1→0) would need an O(n) swap network
+per layer on an MPS and is deliberately not offered.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from qfedx_tpu.ops.linalg import truncated_svd
+
+RDTYPE = jnp.float32
+
+# CNOT as a (2,2,2,2) real tensor G[s1', s2', s1, s2], control = index 1.
+_CNOT = (
+    jnp.zeros((2, 2, 2, 2), dtype=RDTYPE)
+    .at[0, 0, 0, 0].set(1.0)
+    .at[0, 1, 0, 1].set(1.0)
+    .at[1, 1, 1, 0].set(1.0)
+    .at[1, 0, 1, 1].set(1.0)
+)
+
+
+def product_mps(amps: jnp.ndarray, chi: int) -> jnp.ndarray:
+    """Product state from per-qubit 2-vectors: amps (n, 2) → (n, χ, 2, χ)."""
+    n = amps.shape[0]
+    a = jnp.zeros((n, chi, 2, chi), dtype=RDTYPE)
+    return a.at[:, 0, :, 0].set(amps.astype(RDTYPE))
+
+
+def zero_mps(n: int, chi: int) -> jnp.ndarray:
+    """|0…0⟩."""
+    amps = jnp.zeros((n, 2), dtype=RDTYPE).at[:, 0].set(1.0)
+    return product_mps(amps, chi)
+
+
+def apply_1q(a: jnp.ndarray, k: int, g: jnp.ndarray) -> jnp.ndarray:
+    """Real 2×2 gate on site k: A_k[l,s,r] ← Σ_t g[s,t] A_k[l,t,r]."""
+    return a.at[k].set(jnp.einsum("st,ltr->lsr", g, a[k]))
+
+
+def apply_1q_all(a: jnp.ndarray, gs: jnp.ndarray) -> jnp.ndarray:
+    """Per-site 2×2 gates in one shot: gs (n, 2, 2)."""
+    return jnp.einsum("nst,nltr->nlsr", gs, a)
+
+
+def apply_2q_neighbor(a: jnp.ndarray, k: int, g4: jnp.ndarray) -> jnp.ndarray:
+    """Real two-site gate G[s1',s2',s1,s2] on (k, k+1), SVD-truncated to χ.
+
+    Merge → apply → split is the textbook TEBD step; the split is
+    ops.linalg.safe_svd so the whole thing differentiates. Singular
+    values are absorbed into the right tensor (mixed gauge); the state
+    is NOT renormalized here — readout divides by the norm.
+    """
+    chi = a.shape[1]
+    theta = jnp.einsum("lsm,mtr->lstr", a[k], a[k + 1])  # (χ,2,2,χ)
+    theta = jnp.einsum("uvst,lstr->luvr", g4, theta)
+    m = theta.reshape(2 * chi, 2 * chi)
+    u, s, vh = truncated_svd(m, chi)
+    left = u.reshape(chi, 2, chi)
+    right = (s[:, None] * vh).reshape(chi, 2, chi)
+    return a.at[k].set(left).at[k + 1].set(right)
+
+
+def apply_cnot_chain(a: jnp.ndarray) -> jnp.ndarray:
+    """CNOT (k→k+1) for k = 0..n−2 — the line entangler."""
+    n = a.shape[0]
+    for k in range(n - 1):
+        a = apply_2q_neighbor(a, k, _CNOT)
+    return a
+
+
+def _transfer(left: jnp.ndarray, site: jnp.ndarray,
+              weight: jnp.ndarray | None = None) -> jnp.ndarray:
+    """L' = Σ_s w_s · A[s]ᵀ L A[s] — one site of the norm/⟨Z⟩ contraction."""
+    if weight is None:
+        return jnp.einsum("lm,lsa,msb->ab", left, site, site)
+    return jnp.einsum("s,lm,lsa,msb->ab", weight, left, site, site)
+
+
+def norm_sq(a: jnp.ndarray) -> jnp.ndarray:
+    """⟨ψ|ψ⟩ (truncation makes it < 1)."""
+    n, chi = a.shape[0], a.shape[1]
+    left = jnp.zeros((chi, chi), dtype=RDTYPE).at[0, 0].set(1.0)
+    for k in range(n):
+        left = _transfer(left, a[k])
+    return left[0, 0]
+
+
+def expect_z_all(a: jnp.ndarray) -> jnp.ndarray:
+    """⟨Z_k⟩/⟨ψ|ψ⟩ for every site, shape (n,).
+
+    One left-to-right prefix sweep + one right-to-left suffix sweep of
+    transfer matrices — O(n·χ³) total, matching ops.statevector's
+    expect_z_all contract (but normalized, since truncation shrinks the
+    state).
+    """
+    n, chi = a.shape[0], a.shape[1]
+    z = jnp.array([1.0, -1.0], dtype=RDTYPE)
+
+    lefts = [jnp.zeros((chi, chi), dtype=RDTYPE).at[0, 0].set(1.0)]
+    for k in range(n):
+        lefts.append(_transfer(lefts[-1], a[k]))
+    rights = [jnp.zeros((chi, chi), dtype=RDTYPE).at[0, 0].set(1.0)]
+    for k in reversed(range(n)):
+        # Suffix transfer: R' = Σ_s A[s] R A[s]ᵀ.
+        rights.append(jnp.einsum("ab,lsa,msb->lm", rights[-1], a[k], a[k]))
+    rights.reverse()  # rights[k] closes sites k..n−1
+
+    nrm = lefts[n][0, 0]
+    out = []
+    for k in range(n):
+        lz = _transfer(lefts[k], a[k], weight=z)
+        out.append(jnp.sum(lz * rights[k + 1]))
+    return jnp.stack(out) / jnp.maximum(nrm, 1e-12)

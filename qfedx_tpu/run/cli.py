@@ -47,9 +47,13 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--partition", default="iid", choices=["iid", "dirichlet"])
     t.add_argument("--alpha", type=float, default=0.5)
     # model
-    t.add_argument("--model", default="vqc", choices=["vqc", "cnn", "qkernel"])
+    t.add_argument("--model", default="vqc",
+                   choices=["vqc", "cnn", "qkernel", "mps"])
     t.add_argument("--qubits", type=int, default=8)
     t.add_argument("--layers", type=int, default=2)
+    t.add_argument("--bond-dim", type=int, default=16,
+                   help="MPS bond dimension χ (model=mps; the tensor-network "
+                        "path for qubit counts past the dense ~20q wall)")
     t.add_argument("--encoding", default="angle",
                    choices=["angle", "amplitude", "reupload"])
     t.add_argument("--landmarks", type=int, default=16)
@@ -132,6 +136,7 @@ def config_from_args(a: argparse.Namespace) -> ExperimentConfig:
             n_qubits=a.qubits,
             n_layers=a.layers,
             encoding=a.encoding,
+            bond_dim=a.bond_dim,
             n_landmarks=a.landmarks,
             sv_size=a.sv_size,
             depolarizing_p=a.depolarizing,

@@ -35,10 +35,13 @@ class DataConfig:
 
 @dataclass(frozen=True)
 class ModelConfig:
-    model: str = "vqc"  # vqc | cnn | qkernel
+    model: str = "vqc"  # vqc | cnn | qkernel | mps
     n_qubits: int = 8
     n_layers: int = 2
     encoding: str = "angle"  # angle | amplitude | reupload
+    # MPS bond dimension χ (model="mps"): the accuracy/cost knob of the
+    # tensor-network simulator for n_qubits ≫ 20 (reference ROADMAP.md:86).
+    bond_dim: int = 16
     # Statevector sharding degree (power of two). >1 routes the VQC onto
     # the device-sharded engine (models.vqc_sharded) — the ≥20-qubit
     # regime where one chip's HBM can't hold 2^n amplitudes per sample
@@ -90,6 +93,31 @@ def build_model(cfg: ExperimentConfig, num_classes: int):
             height=spec.height,
             width=spec.width,
             in_channels=spec.channels,
+        )
+    if m.model == "mps":
+        from qfedx_tpu.models.vqc_mps import make_mps_classifier
+
+        if m.encoding != "angle":
+            raise ValueError(
+                "model='mps' simulates the real-amplitudes circuit family "
+                "(angle/RY encoding only); got encoding="
+                f"{m.encoding!r}"
+            )
+        if m.depolarizing_p or m.amp_damping_gamma or m.readout_flip or m.shots:
+            raise ValueError(
+                "model='mps' has no noise support; noise channels are a "
+                "dense/sv-sharded engine feature (ROADMAP.md:64-73)"
+            )
+        if m.sv_size > 1:
+            raise ValueError(
+                "model='mps' is single-device per sample (O(n·χ²) memory); "
+                "sv_size>1 applies to the dense sharded engine"
+            )
+        return make_mps_classifier(
+            m.n_qubits,
+            n_layers=m.n_layers,
+            num_classes=num_classes,
+            bond_dim=m.bond_dim,
         )
     if m.model == "qkernel":
         from qfedx_tpu.models.kernel import make_quantum_kernel_classifier
@@ -150,7 +178,7 @@ def build_data(cfg: ExperimentConfig) -> dict[str, Any]:
     from qfedx_tpu.data.pipeline import preprocess
 
     d, m = cfg.data, cfg.model
-    is_quantum = m.model in ("vqc", "qkernel")
+    is_quantum = m.model in ("vqc", "qkernel", "mps")
     n_features = d.n_features
     features = d.features
     if is_quantum:

@@ -134,3 +134,22 @@ def test_viz_outputs(tmp_path):
     flat = rng.uniform(0, 1, (40, 6)).astype(np.float32)  # non-square features
     p3 = save_client_samples(flat, parts, tmp_path / "flat.png")
     assert p3.exists()
+
+
+def test_run_train_mps_model(tmp_path):
+    """--model mps: the tensor-network simulator through the full CLI path
+    at a qubit count the dense engine also handles (fast), plus flag
+    mapping for --bond-dim."""
+    cfg = parse(
+        [
+            "train", "--model", "mps", "--qubits", "6", "--bond-dim", "4",
+            "--layers", "1", "--classes", "0,1", "--clients", "4",
+            "--rounds", "2", "--local-epochs", "1", "--batch-size", "8",
+            "--lr", "0.1", "--optimizer", "adam",
+            "--run-root", str(tmp_path), "--name", "m",
+        ]
+    )
+    assert cfg.model.model == "mps" and cfg.model.bond_dim == 4
+    summary = run_train(cfg)
+    assert 0.0 <= summary["final_accuracy"] <= 1.0
+    assert (tmp_path / "m" / "summary.json").exists()
