@@ -42,8 +42,25 @@ import time
 import numpy as np
 
 
+def _enable_compile_cache(jax):
+    """Persistent compilation cache next to the repo: the fused-kernel
+    backward is a large Mosaic program (minutes to compile at 16q); the
+    cache makes every bench run after the first start hot."""
+    import os
+
+    try:
+        cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
+
+
 def _build():
     import jax
+
+    _enable_compile_cache(jax)
 
     from qfedx_tpu.fed.client import make_local_update
     from qfedx_tpu.fed.config import FedConfig
@@ -102,6 +119,33 @@ def _time_spmd(jax, model, cfg, mesh, num_clients, data, make_fed_round,
         times.append(time.perf_counter() - t0)
     # Median: robust to transient dispatch-latency spikes (tunneled TPU).
     return sorted(times)[len(times) // 2]
+
+
+def _time_spmd_scanned(jax, model, cfg, mesh, num_clients, data,
+                       shard_client_data, rounds_per_call=10, reps=5):
+    """The trainer's optimized path (--rounds-per-call): K rounds scanned
+    inside one dispatch (fed.round.make_fed_rounds, bit-identical to
+    sequential rounds). Returns median seconds PER ROUND."""
+    from qfedx_tpu.fed.round import make_fed_rounds
+
+    cx, cy, cmask = data
+    rounds_fn = make_fed_rounds(
+        model, cfg, mesh, num_clients=num_clients,
+        rounds_per_call=rounds_per_call,
+    )
+    scx, scy, scm = shard_client_data(mesh, cx, cy, np.asarray(cmask))
+    params = model.init(jax.random.PRNGKey(0))
+    base = jax.random.PRNGKey(1)
+    params, _ = rounds_fn(params, scx, scy, scm, base, 0)  # compile
+    params, _ = rounds_fn(params, scx, scy, scm, base, 1)  # steady layout
+    jax.block_until_ready(params)
+    times = []
+    for r in range(reps):
+        t0 = time.perf_counter()
+        params, _ = rounds_fn(params, scx, scy, scm, base, r)
+        jax.block_until_ready(params)
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2] / rounds_per_call
 
 
 def _time_sequential(jax, model, cfg, num_clients, data, make_local_update,
@@ -171,6 +215,22 @@ def _dense_cost_model(n_qubits: int, n_layers: int):
     return gates, flops, bytes_
 
 
+def _with_env(env: dict, fn, *a, **k):
+    """Run fn with env vars set, restoring previous values after."""
+    import os
+
+    prev = {var: os.environ.get(var) for var in env}
+    os.environ.update(env)
+    try:
+        return fn(*a, **k)
+    finally:
+        for var, old in prev.items():
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
+
+
 def _bench_compute_bound(jax, n_qubits=16, n_layers=3, batch=64, reps=5,
                          steps=8):
     """Batched forward+grad of the dense n-qubit VQC — simulation-dominated
@@ -208,13 +268,22 @@ def _bench_compute_bound(jax, n_qubits=16, n_layers=3, batch=64, reps=5,
 
     p_out, ls = many_steps(params)  # compile
     jax.block_until_ready(ls)
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        p_out, ls = many_steps(params)
-        jax.block_until_ready(ls)
-        times.append(time.perf_counter() - t0)
-    t = sorted(times)[len(times) // 2] / steps
+
+    def measure():
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            p_out, ls = many_steps(params)
+            jax.block_until_ready(ls)
+            times.append(time.perf_counter() - t0)
+        return sorted(times)[len(times) // 2] / steps
+
+    t = measure()
+    # Transient tunnel glitches have produced ~0s timings (a blocked-on
+    # value that was already resident); this workload cannot run in <1ms
+    # per step, so re-measure rather than record a bogus 1000× number.
+    if t < 1e-3:
+        t = measure()
 
     gates, fwd_flops, fwd_bytes = _dense_cost_model(n_qubits, n_layers)
     total_flops = 3 * batch * fwd_flops  # fwd + ~2x bwd
@@ -234,23 +303,34 @@ def _bench_compute_bound(jax, n_qubits=16, n_layers=3, batch=64, reps=5,
 
 
 def _bench_pallas(jax, n_qubits=16, n_layers=3, batch=64):
-    """The same compute-bound program with the Pallas kernel routed in
-    (QFEDX_PALLAS=1 read at trace time) vs the default XLA path."""
-    import os
-
+    """The same compute-bound program with the per-gate Pallas kernel
+    routed in (QFEDX_PALLAS=1, fused off) vs the plain XLA path."""
     if jax.devices()[0].platform == "cpu":
         return {"skipped": "pallas kernel needs TPU (interpret mode is test-only)"}
-    prev = os.environ.get("QFEDX_PALLAS")
     try:
-        os.environ["QFEDX_PALLAS"] = "1"
-        on = _bench_compute_bound(jax, n_qubits, n_layers, batch)
+        on = _with_env(
+            {"QFEDX_PALLAS": "1", "QFEDX_FUSED": "0"},
+            _bench_compute_bound, jax, n_qubits, n_layers, batch,
+        )
     except Exception as e:  # noqa: BLE001 — report, don't kill the bench
         return {"error": f"{type(e).__name__}: {e}"}
-    finally:
-        if prev is None:
-            os.environ.pop("QFEDX_PALLAS", None)
-        else:
-            os.environ["QFEDX_PALLAS"] = prev
+    return {"fwd_grad_s": on["fwd_grad_s"], "est_hbm_gbps": on["est_hbm_gbps"]}
+
+
+def _bench_fused(jax, n_qubits=16, n_layers=3, batch=64):
+    """The same compute-bound program through the fused whole-circuit
+    kernel + adjoint backward (QFEDX_FUSED=1, ops/fused_hea.py). First
+    run pays a multi-minute Mosaic compile; the persistent compilation
+    cache (enabled in _build) makes subsequent bench runs hot."""
+    if jax.devices()[0].platform == "cpu":
+        return {"skipped": "fused kernel needs TPU (interpret mode is test-only)"}
+    try:
+        on = _with_env(
+            {"QFEDX_FUSED": "1"},
+            _bench_compute_bound, jax, n_qubits, n_layers, batch,
+        )
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"}
     return {"fwd_grad_s": on["fwd_grad_s"], "est_hbm_gbps": on["est_hbm_gbps"]}
 
 
@@ -302,6 +382,14 @@ def main():
         jax, model, cfg, mesh, num_clients, data, make_fed_round, shard_client_data
     )
     seq_s = _time_sequential(jax, model, cfg, num_clients, data, make_local_update)
+    scan_k = 10
+    try:
+        scan_s = _time_spmd_scanned(
+            jax, model, cfg, mesh, num_clients, data, shard_client_data,
+            rounds_per_call=scan_k,
+        )
+    except Exception:  # noqa: BLE001 — fall back to the per-dispatch number
+        scan_s, scan_k = spmd_s, 1
 
     def safe(fn, *a, **k):
         try:
@@ -309,15 +397,28 @@ def main():
         except Exception as e:  # noqa: BLE001
             return {"error": f"{type(e).__name__}: {e}"}
 
-    compute = safe(_bench_compute_bound)
+    # Baseline XLA path measured with the fused auto-route pinned off, so
+    # the three rows are the three engines, not "whatever auto picked".
+    compute = safe(
+        lambda j: _with_env({"QFEDX_FUSED": "0"}, _bench_compute_bound, j)
+    )
     pallas = safe(_bench_pallas)
+    fused = safe(_bench_fused)
     if "fwd_grad_s" in compute and "fwd_grad_s" in pallas:
         pallas["speedup_vs_xla"] = round(
             compute["fwd_grad_s"] / pallas["fwd_grad_s"], 3
         )
+    if "fwd_grad_s" in compute and "fwd_grad_s" in fused:
+        fused["speedup_vs_xla"] = round(
+            compute["fwd_grad_s"] / fused["fwd_grad_s"], 3
+        )
     ttt = safe(_bench_time_to_target)
 
-    value = num_clients / spmd_s / n_dev
+    # Headline: the trainer's optimized path (K rounds scanned per
+    # dispatch — CLI --rounds-per-call, bit-identical training). The
+    # per-dispatch number is kept alongside for the latency-bound view.
+    value = num_clients / scan_s / n_dev
+    per_dispatch = num_clients / spmd_s / n_dev
     baseline_value = num_clients / seq_s / n_dev
     print(
         json.dumps(
@@ -326,8 +427,11 @@ def main():
                 "value": round(value, 3),
                 "unit": "client-rounds/s/chip",
                 "vs_baseline": round(value / baseline_value, 3),
+                "rounds_per_call": scan_k,
+                "per_dispatch_value": round(per_dispatch, 3),
                 "compute_bound": compute,
                 "pallas": pallas,
+                "fused": fused,
                 "time_to_target": ttt,
             }
         )

@@ -13,8 +13,11 @@ import json
 import os
 import sys
 import time
+from pathlib import Path
 
 import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
 
 
 def build_step(n_qubits, n_layers, batch, steps=8):

@@ -30,11 +30,12 @@ accumulate dθ = λᵀ(∂U/∂θ)ψ via per-qubit 2×2 reduction matrices, and
 
 Scope: the angle-encoded (real product state) hardware-efficient circuit
 of models.vqc — encoder → L × [rot_zx per qubit + CNOT ring] → ⟨Z_k⟩ —
-with 8 ≤ n ≤ 18 (n ≥ 8 so a full 128-lane dim exists; n ≤ 18 so the
-working set fits VMEM). Everything else falls back to the per-gate
-engine. Routing: `fused_enabled()` (QFEDX_FUSED=1 forces on, =0 forces
-off; unset → on-TPU auto for n ≥ AUTO_MIN_QUBITS, where fusion is the
-difference between HBM-bound and VMEM-resident).
+with 8 ≤ n ≤ 16 (n ≥ 8 so a full 128-lane dim exists; above 16 the
+Mosaic compile time becomes impractical — see MAX_QUBITS). Everything
+else falls back to the per-gate engine. Routing: `fused_enabled()`
+(QFEDX_FUSED=1 forces on, =0 forces off; unset → on-TPU auto for
+n ≥ AUTO_MIN_QUBITS, the measured-win regime). v5e measurements (batch
+64, 3 layers, fwd+grad): 1.41× vs the XLA path at n=16, parity at ≤12.
 """
 
 from __future__ import annotations
@@ -50,8 +51,16 @@ from jax.experimental.pallas import tpu as pltpu
 LANES = 128
 LANE_QUBITS = 7  # 2^7 = 128
 MIN_QUBITS = 8
-MAX_QUBITS = 18
-AUTO_MIN_QUBITS = 12
+# 17–18 qubits fit the raised VMEM budget on paper but their Mosaic
+# compiles run tens of minutes (unrolled per-qubit program × state size)
+# — not shippable today; the sv-sharded engine covers that regime.
+MAX_QUBITS = 16
+# Auto-route threshold, set from v5e measurement (fwd+grad, batch 64, 3
+# layers; benchmarks/fused_sweep.py): n=12 → 1.02× vs XLA (dispatch-
+# bound, not worth the compile), n=14 → 1.11×, n=16 → 1.41× and growing
+# with n as the XLA path goes HBM-bound and its autodiff tape approaches
+# HBM capacity. Below the threshold QFEDX_FUSED=1 still forces the path.
+AUTO_MIN_QUBITS = 16
 
 _INTERPRET = False  # flipped by tests on CPU
 
@@ -255,18 +264,20 @@ def _entangle_ring_reverse(x, y, n: int):
 
 
 def _z_signs(n: int, q: int, r: int):
-    """±1 sign array broadcastable against (BB, R, 128) for ⟨Z_q⟩."""
+    """±1 sign array (R, 128) for ⟨Z_q⟩ (broadcasts against per-sample
+    (R, 128) slices; rank 2 — Mosaic's layout inference chokes on
+    singleton-leading reductions, so per-sample work stays rank 2)."""
     if q <= n - LANE_QUBITS - 1:
-        rbit = (
-            jax.lax.broadcasted_iota(jnp.int32, (1, r, LANES), 1)
+        bit = (
+            jax.lax.broadcasted_iota(jnp.int32, (r, LANES), 0)
             >> _row_bitpos(n, q)
         ) & 1
-        return (1 - 2 * rbit).astype(jnp.float32)
-    lbit = (
-        jax.lax.broadcasted_iota(jnp.int32, (1, r, LANES), 2)
-        >> _lane_bitpos(n, q)
-    ) & 1
-    return (1 - 2 * lbit).astype(jnp.float32)
+    else:
+        bit = (
+            jax.lax.broadcasted_iota(jnp.int32, (r, LANES), 1)
+            >> _lane_bitpos(n, q)
+        ) & 1
+    return (1 - 2 * bit).astype(jnp.float32)
 
 
 # --------------------------------------------------------------------------
@@ -278,15 +289,30 @@ def _fwd_kernel(n: int, n_layers: int, save_state: bool,
                 rx_ref, rz_ref, enc_ref, zexp_ref, xf_ref=None, yf_ref=None):
     x = enc_ref[...]
     y = jnp.zeros_like(x)
-    for layer in range(n_layers):
+
+    # The layer loop is a lax.fori_loop with the layer index traced (SMEM
+    # angle reads take dynamic indices): the Mosaic program contains ONE
+    # layer body instead of n_layers copies — compile time at 14–16
+    # qubits is minutes per copy, so this is what keeps it usable.
+    def layer(li, carry):
+        x, y = carry
         for q in range(n):
-            ur, ui = _rot_entries(rx_ref[layer, q], rz_ref[layer, q])
+            ur, ui = _rot_entries(rx_ref[li, q], rz_ref[li, q])
             x, y = _apply_rot(x, y, n, q, ur, ui)
-        x, y = _entangle_ring(x, y, n)
+        return _entangle_ring(x, y, n)
+
+    x, y = jax.lax.fori_loop(0, n_layers, layer, (x, y))
     probs = x * x + y * y
-    r = x.shape[1]
-    cols = [jnp.sum(probs * _z_signs(n, q, r), axis=(1, 2)) for q in range(n)]
-    zexp_ref[...] = jnp.stack(cols, axis=1)
+    bb, r = x.shape[0], x.shape[1]
+    # zexp lives in SMEM and is written as per-(sample, qubit) scalar
+    # stores from full reductions of rank-2 per-sample slices: vector
+    # writes of tiny (bb, n) blocks violate TPU block-divisibility rules,
+    # and singleton-batch vector reductions hit Mosaic relayout bugs.
+    row0 = pl.program_id(0) * bb
+    for b in range(bb):
+        pb = probs[b]
+        for q in range(n):
+            zexp_ref[row0 + b, q] = jnp.sum(pb * _z_signs(n, q, r))
     if save_state:
         xf_ref[...] = x
         yf_ref[...] = y
@@ -305,7 +331,11 @@ def _w_matrices(n: int, q: int, lx, ly, px, py):
 
     so that dθ = Σ_ab dUr[a,b]·Wrr[a,b] + dUi[a,b]·Wri[a,b] — the VJP of
     a complex 2×2 gate through the real-pair linear map, reduced over
-    batch and all non-target amplitudes."""
+    batch and all non-target amplitudes. Scalar full-reductions only
+    (Mosaic's tpu.matmul rejects the transposed/multi-dim dot_general
+    forms that would avoid the product temporaries; the scoped-VMEM cost
+    of those temporaries is covered by _block_batch's heavy budget plus
+    the raised --xla_tpu_scoped_vmem_limit_kib the wrapper requests)."""
     if q <= n - LANE_QUBITS - 1:
         lxs, lys = _split_row(lx, n, q), _split_row(ly, n, q)
         pxs, pys = _split_row(px, n, q), _split_row(py, n, q)
@@ -351,42 +381,54 @@ def _bwd_kernel(n: int, n_layers: int,
                 rx_ref, rz_ref, xf_ref, yf_ref, ct_ref, drx_ref, drz_ref):
     x = xf_ref[...]
     y = yf_ref[...]
-    ct = ct_ref[...]  # (BB, n)
     bb, r = x.shape[0], x.shape[1]
 
     # λ = ∂(Σ_k ct_k ⟨Z_k⟩)/∂ψ = 2·S∘ψ with S = Σ_k ct_k σ_k (diagonal).
-    s = jnp.zeros_like(x)
-    for q in range(n):
-        s = s + ct[:, q].reshape(bb, 1, 1) * _z_signs(n, q, r)
+    # ct is SMEM; S is built per sample from scalar ct reads × rank-2
+    # sign patterns (same Mosaic singleton-layout avoidance as the
+    # forward's zexp), then stacked along the leading sample dim.
+    row0 = pl.program_id(0) * bb
+    per_sample = []
+    for b in range(bb):
+        sb = ct_ref[row0 + b, 0] * _z_signs(n, 0, r)
+        for q in range(1, n):
+            sb = sb + ct_ref[row0 + b, q] * _z_signs(n, q, r)
+        per_sample.append(sb)
+    s = jnp.stack(per_sample, axis=0)
     lx, ly = 2.0 * s * x, 2.0 * s * y
 
-    drx_cols: list[list] = [[None] * n for _ in range(n_layers)]
-    drz_cols: list[list] = [[None] * n for _ in range(n_layers)]
-    for layer in reversed(range(n_layers)):
+    # Gradient outputs live in SMEM and are written as scalar stores —
+    # the contributions are true scalars (full reductions), and stacking
+    # them into vectors would reintroduce the rank-1 layouts Mosaic
+    # rejects. Zero once on the first grid step, then every step
+    # accumulates (TPU grid iterations are sequential).
+    @pl.when(pl.program_id(0) == 0)
+    def _zero():
+        for layer in range(n_layers):
+            for q in range(n):
+                drx_ref[layer, q] = jnp.float32(0.0)
+                drz_ref[layer, q] = jnp.float32(0.0)
+
+    # Reverse layer sweep as a fori_loop (ONE layer body in the Mosaic
+    # program — see _fwd_kernel); iteration i processes layer L-1-i,
+    # accumulating into SMEM at the dynamic layer index.
+    def layer_bwd(i, carry):
+        x, y, lx, ly = carry
+        li = n_layers - 1 - i
         x, y = _entangle_ring_reverse(x, y, n)
         lx, ly = _entangle_ring_reverse(lx, ly, n)
         for q in reversed(range(n)):
-            theta, phi = rx_ref[layer, q], rz_ref[layer, q]
+            theta, phi = rx_ref[li, q], rz_ref[li, q]
             ur, ui = _rot_entries_adjoint(theta, phi)
             x, y = _apply_rot(x, y, n, q, ur, ui)  # ψ_pre (uncompute)
             wrr, wri = _w_matrices(n, q, lx, ly, x, y)
             dth, dph = _rot_derivs(theta, phi)
-            drx_cols[layer][q] = _contract_w(dth, wrr, wri)
-            drz_cols[layer][q] = _contract_w(dph, wrr, wri)
+            drx_ref[li, q] += _contract_w(dth, wrr, wri)
+            drz_ref[li, q] += _contract_w(dph, wrr, wri)
             lx, ly = _apply_rot(lx, ly, n, q, ur, ui)  # λ ← U†λ
+        return x, y, lx, ly
 
-    drx = jnp.stack([jnp.stack(row) for row in drx_cols])
-    drz = jnp.stack([jnp.stack(row) for row in drz_cols])
-
-    @pl.when(pl.program_id(0) == 0)
-    def _init():
-        drx_ref[...] = drx
-        drz_ref[...] = drz
-
-    @pl.when(pl.program_id(0) != 0)
-    def _acc():
-        drx_ref[...] += drx
-        drz_ref[...] += drz
+    jax.lax.fori_loop(0, n_layers, layer_bwd, (x, y, lx, ly))
 
 
 # --------------------------------------------------------------------------
@@ -394,14 +436,28 @@ def _bwd_kernel(n: int, n_layers: int,
 # --------------------------------------------------------------------------
 
 
-def _block_batch(n: int, batch: int) -> int:
-    """Samples per grid step: keep x+y ≈ ≤2MB so the working set (state,
-    λ, pipeline buffers) stays well inside the ~16MB scoped VMEM — and
-    never larger than the (power-of-two-rounded) real batch, so small
+# Raised per-kernel scoped-VMEM budget (v5e has 128MB VMEM; the default
+# 16MB scoped limit is tuned for small fused ops, not a whole-circuit
+# program whose unrolled gate chain + adjoint temporaries legitimately
+# stack tens of MB). Interpret mode ignores it.
+_VMEM_LIMIT = 100 * 1024 * 1024
+
+
+def _compiler_params():
+    return pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+
+
+def _block_batch(n: int, batch: int, heavy: bool = False) -> int:
+    """Samples per grid step, sized to the ~16MB scoped VMEM: the live set
+    is the (re, im) state slabs plus Mosaic's stack of unrolled-gate
+    temporaries. ``heavy`` covers the residual-saving forward and the
+    adjoint backward (extra xf/yf outputs resp. λ slabs — measured on
+    v5e: the light budget OOMed the heavy variants at n=14 by ~5%).
+    Never larger than the (power-of-two-rounded) real batch, so small
     batches aren't zero-padded up to the VMEM budget."""
     bb = int(os.environ.get("QFEDX_FUSED_BB", "0"))
     if bb <= 0:
-        bb = max(1, 1 << max(0, 17 - n))
+        bb = max(1, 1 << max(0, (16 if heavy else 17) - n))
     cap = 1
     while cap < batch:
         cap <<= 1
@@ -442,17 +498,17 @@ def _fwd_call(rx, rz, enc, n_qubits: int, n_layers: int, save_state: bool):
     n, el = n_qubits, n_layers
     b = enc.shape[0]
     r = 1 << (n - LANE_QUBITS)
-    bb = _block_batch(n, b)
+    bb = _block_batch(n, b, heavy=save_state)
     encp = _pad_batch(enc, bb).reshape(-1, r, LANES)
     bp = encp.shape[0]
     grid = (bp // bb,)
     kernel = functools.partial(_fwd_kernel, n, el, save_state)
     smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
     slab = lambda: pl.BlockSpec((bb, r, LANES), lambda i: (i, 0, 0))
-    zspec = pl.BlockSpec((bb, n), lambda i: (i, 0))
     zshape = jax.ShapeDtypeStruct((bp, n), jnp.float32)
     sshape = jax.ShapeDtypeStruct((bp, r, LANES), jnp.float32)
-    out_specs = [zspec] + ([slab(), slab()] if save_state else [])
+    # zexp is an SMEM output written as scalar stores (see _fwd_kernel).
+    out_specs = [smem()] + ([slab(), slab()] if save_state else [])
     out_shape = [zshape] + ([sshape, sshape] if save_state else [])
     outs = pl.pallas_call(
         kernel,
@@ -460,6 +516,7 @@ def _fwd_call(rx, rz, enc, n_qubits: int, n_layers: int, save_state: bool):
         in_specs=[smem(), smem(), slab()],
         out_specs=out_specs,
         out_shape=out_shape,
+        compiler_params=_compiler_params(),
         interpret=_INTERPRET,
     )(rx, rz, encp)
     return (outs[0][:b],) + tuple(outs[1:])
@@ -475,23 +532,23 @@ def _hea_bwd(n_qubits, n_layers, res, ct):
     n, el = n_qubits, n_layers
     r = 1 << (n - LANE_QUBITS)
     bp = xf.shape[0]
-    bb = _block_batch(n, bp)
+    bb = _block_batch(n, bp, heavy=True)
     ctp = _pad_batch(ct, bb)  # zero cotangent for padded samples
     grid = (bp // bb,)
     kernel = functools.partial(_bwd_kernel, n, el)
     smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
     slab = lambda: pl.BlockSpec((bb, r, LANES), lambda i: (i, 0, 0))
-    acc = lambda: pl.BlockSpec((el, n), lambda i: (0, 0))
+    acc = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
     drx, drz = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[smem(), smem(), slab(), slab(),
-                  pl.BlockSpec((bb, n), lambda i: (i, 0))],
+        in_specs=[smem(), smem(), slab(), slab(), smem()],
         out_specs=[acc(), acc()],
         out_shape=[
             jax.ShapeDtypeStruct((el, n), jnp.float32),
             jax.ShapeDtypeStruct((el, n), jnp.float32),
         ],
+        compiler_params=_compiler_params(),
         interpret=_INTERPRET,
     )(rx, rz, xf, yf, ctp)
     # enc is data, not parameters (documented in hea_zexp): zero cotangent.

@@ -86,6 +86,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ring hops k; unmasking a client needs its 2k neighbors to collude")
     # run
     t.add_argument("--eval-every", type=int, default=1)
+    t.add_argument("--rounds-per-call", type=int, default=1,
+                   help="scan this many rounds inside one device dispatch "
+                        "(bit-identical; amortizes host\u2194device latency — "
+                        "Clamped to min(--eval-every, --checkpoint-every) - "
+                        "raise those cadences to scan deeper")
     t.add_argument("--eval-batches", type=int, default=None,
                    help="cap per-round eval at this many 256-sample batches")
     t.add_argument("--checkpoint-every", type=int, default=5)
@@ -160,6 +165,7 @@ def config_from_args(a: argparse.Namespace) -> ExperimentConfig:
         ),
         num_rounds=a.rounds,
         eval_every=a.eval_every,
+        rounds_per_call=a.rounds_per_call,
         eval_batches=a.eval_batches,
         checkpoint_every=a.checkpoint_every,
         seed=a.seed,
@@ -226,6 +232,7 @@ def run_train(
                 seed=cfg.seed,
                 eval_every=cfg.eval_every,
                 eval_batches=cfg.eval_batches,
+                rounds_per_call=cfg.rounds_per_call,
                 on_round_end=lambda r, m: (
                     run.on_round_end(r, m),
                     say(f"[round {r + 1:3d}] " + json.dumps(m)) if (r + 1) % 5 == 0 else None,

@@ -64,6 +64,9 @@ class ExperimentConfig:
     fed: FedConfig = field(default_factory=FedConfig)
     num_rounds: int = 30  # reference Classical_FL.py:168
     eval_every: int = 1
+    # Rounds scanned inside one device dispatch (fed.round.make_fed_rounds):
+    # bit-identical to sequential rounds, amortizes host↔device latency.
+    rounds_per_call: int = 1
     eval_batches: int | None = None  # cap eval cost on large eval sets
     checkpoint_every: int = 5
     seed: int = 42

@@ -22,7 +22,12 @@ import numpy as np
 from qfedx_tpu.fed.accountant import RDPAccountant
 from qfedx_tpu.fed.config import FedConfig
 from qfedx_tpu.fed.evaluate import make_evaluator
-from qfedx_tpu.fed.round import client_mesh, make_fed_round, shard_client_data
+from qfedx_tpu.fed.round import (
+    client_mesh,
+    make_fed_round,
+    make_fed_rounds,
+    shard_client_data,
+)
 from qfedx_tpu.models.api import Model
 from qfedx_tpu.utils import trees
 
@@ -62,6 +67,7 @@ def train_federated(
     eval_batches: int | None = None,
     on_round_end: Callable[[int, dict], None] | None = None,
     checkpointer=None,
+    rounds_per_call: int = 1,
 ) -> TrainResult:
     """Run federated training; returns params + metric history.
 
@@ -70,6 +76,12 @@ def train_federated(
     ``on_round_end(round_idx, metrics)``: observability hook (metrics logger).
     ``checkpointer``: optional ``run.checkpoint.Checkpointer`` for
     save-every-K/resume.
+    ``rounds_per_call``: scan this many rounds inside one device dispatch
+    (bit-identical to sequential rounds; tested). Eval/checkpoint cadences
+    still hold: chunks never cross an eval or checkpoint boundary, so a
+    cadence-K run should pick rounds_per_call dividing eval_every and the
+    checkpoint interval for full effect. Per-round wall-clock inside a
+    chunk is reported as chunk_time/chunk_len.
     """
     num_clients = cx.shape[0]
     if mesh is None:
@@ -102,6 +114,39 @@ def train_federated(
                 n_dev -= 1
             mesh = client_mesh(num_devices=n_dev)
     round_fn = make_fed_round(model, cfg, mesh, num_clients=num_clients)
+    # Clamp the scan length to what the eval/checkpoint cadences allow —
+    # chunks never cross a host-action boundary, so a larger K would
+    # silently never engage. Warn so the user knows the effective value.
+    requested_rpc = max(1, int(rounds_per_call))
+    rounds_per_call = min(
+        requested_rpc,
+        eval_every,
+        checkpointer.every if checkpointer is not None else requested_rpc,
+    )
+    if rounds_per_call < requested_rpc:
+        import warnings
+
+        warnings.warn(
+            f"rounds_per_call clamped {requested_rpc} → {rounds_per_call}: "
+            "scanned chunks cannot cross eval/checkpoint boundaries "
+            f"(eval_every={eval_every}"
+            + (
+                f", checkpoint_every={checkpointer.every}"
+                if checkpointer is not None
+                else ""
+            )
+            + ") — raise those cadences to scan deeper",
+            UserWarning,
+            stacklevel=2,
+        )
+    chunk_fn = (
+        make_fed_rounds(
+            model, cfg, mesh, num_clients=num_clients,
+            rounds_per_call=rounds_per_call,
+        )
+        if rounds_per_call > 1
+        else None
+    )
     # Two evaluators: the capped one paces per-round eval (eval_batches
     # bounds its cost); the uncapped one is exposed on TrainResult so final
     # reported metrics always cover the full eval set.
@@ -161,34 +206,65 @@ def train_federated(
         metrics0 = evaluate(params, test_x, test_y)
         result.accuracies.append(metrics0["accuracy"])
 
-    for rnd in range(start_round, num_rounds):
-        t0 = time.perf_counter()
-        round_key = jax.random.fold_in(round_key_base, rnd)
-        params, stats = round_fn(params, scx, scy, scm, round_key)
-        jax.block_until_ready(params)
-        dt = time.perf_counter() - t0
-        result.round_times_s.append(dt)
-        result.losses.append(float(stats.mean_loss))
+    rnd = start_round
+    while rnd < num_rounds:
+        # Chunk length: never cross an eval or checkpoint boundary (host
+        # actions happen between dispatches), never past the end.
+        until_eval = eval_every - (rnd % eval_every)
+        until_ckpt = (
+            checkpointer.every - (rnd % checkpointer.every)
+            if checkpointer is not None
+            else rounds_per_call
+        )
+        chunk = min(rounds_per_call, until_eval, until_ckpt, num_rounds - rnd)
 
-        metrics = {"round": rnd + 1, "loss": float(stats.mean_loss), "time_s": dt}
-        if accountant is not None:
-            accountant.step(q=cfg.client_fraction, sigma=cfg.dp.noise_multiplier)
-            eps = accountant.epsilon(cfg.dp.delta)
-            result.epsilons.append(eps)
-            metrics["epsilon"] = eps
-        if (rnd + 1) % eval_every == 0 or rnd == num_rounds - 1:
-            eval_metrics = evaluate(params, test_x, test_y)
-            result.accuracies.append(eval_metrics["accuracy"])
-            metrics.update(eval_metrics)
-        if checkpointer is not None:
-            # Always persist the final round — the weights final_accuracy is
-            # reported for must exist on disk even off the every-K cadence.
-            if rnd == num_rounds - 1:
-                checkpointer.save(rnd + 1, params)
-            else:
-                checkpointer.maybe_save(rnd + 1, params)
-        if on_round_end is not None:
-            on_round_end(rnd, metrics)
+        t0 = time.perf_counter()
+        if chunk == rounds_per_call and chunk_fn is not None:
+            params, stats = chunk_fn(
+                params, scx, scy, scm, round_key_base, rnd
+            )
+            jax.block_until_ready(params)
+            losses = [float(l) for l in np.asarray(stats.mean_loss)]
+        else:
+            losses = []
+            for i in range(chunk):
+                round_key = jax.random.fold_in(round_key_base, rnd + i)
+                params, stats = round_fn(params, scx, scy, scm, round_key)
+                losses.append(float(stats.mean_loss))
+            jax.block_until_ready(params)
+        dt_per_round = (time.perf_counter() - t0) / chunk
+
+        for i in range(chunk):
+            r = rnd + i
+            result.round_times_s.append(dt_per_round)
+            result.losses.append(losses[i])
+            metrics = {
+                "round": r + 1,
+                "loss": losses[i],
+                "time_s": dt_per_round,
+            }
+            if accountant is not None:
+                accountant.step(
+                    q=cfg.client_fraction, sigma=cfg.dp.noise_multiplier
+                )
+                eps = accountant.epsilon(cfg.dp.delta)
+                result.epsilons.append(eps)
+                metrics["epsilon"] = eps
+            if (r + 1) % eval_every == 0 or r == num_rounds - 1:
+                eval_metrics = evaluate(params, test_x, test_y)
+                result.accuracies.append(eval_metrics["accuracy"])
+                metrics.update(eval_metrics)
+            if checkpointer is not None:
+                # Always persist the final round — the weights
+                # final_accuracy is reported for must exist on disk even
+                # off the every-K cadence.
+                if r == num_rounds - 1:
+                    checkpointer.save(r + 1, params)
+                else:
+                    checkpointer.maybe_save(r + 1, params)
+            if on_round_end is not None:
+                on_round_end(r, metrics)
+        rnd += chunk
 
     result.params = params
     return result

@@ -15,6 +15,7 @@ from qfedx_tpu.fed.client import make_local_update
 from qfedx_tpu.fed.config import DPConfig, FedConfig
 from qfedx_tpu.fed.round import client_mesh, make_fed_round, shard_client_data
 from qfedx_tpu.models.api import Model
+from qfedx_tpu.models.vqc import make_vqc_classifier
 from qfedx_tpu.utils import trees
 
 
@@ -306,3 +307,72 @@ def test_adam_optimizer_round_runs(mesh):
     new_params, _ = round_fn(params, cx, cy, jnp.asarray(cmask), jax.random.PRNGKey(2))
     assert np.all(np.isfinite(np.asarray(new_params["w"])))
     assert not np.allclose(np.asarray(new_params["w"]), 0.0)
+
+
+def test_scanned_rounds_match_sequential():
+    """make_fed_rounds(K) ≡ K sequential make_fed_round calls, bit-for-bit
+    key derivation included (the trainer's fold_in(base, rnd) scheme) —
+    with DP + secure-agg + sampling on so every PRNG path is exercised."""
+    from qfedx_tpu.fed.round import make_fed_rounds
+
+    num_clients, samples, n_q = 8, 8, 3
+    model = make_vqc_classifier(n_qubits=n_q, n_layers=1, num_classes=2)
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.1, optimizer="adam",
+        client_fraction=0.75,
+        dp=DPConfig(clip_norm=1.0, noise_multiplier=0.5),
+        secure_agg=True,
+    )
+    mesh = client_mesh(num_devices=4)
+    rng = np.random.default_rng(0)
+    cx = rng.uniform(0, 1, (num_clients, samples, n_q)).astype(np.float32)
+    cy = rng.integers(0, 2, (num_clients, samples)).astype(np.int32)
+    cm = np.ones((num_clients, samples), dtype=np.float32)
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+
+    base = jax.random.PRNGKey(7)
+    params0 = model.init(jax.random.PRNGKey(0))
+
+    one = make_fed_round(model, cfg, mesh, num_clients=num_clients)
+    p_seq = params0
+    seq_losses = []
+    for rnd in range(2, 5):  # start_round=2: offset must round-trip too
+        p_seq, st = one(p_seq, scx, scy, scm, jax.random.fold_in(base, rnd))
+        seq_losses.append(float(st.mean_loss))
+
+    chunk = make_fed_rounds(
+        model, cfg, mesh, num_clients=num_clients, rounds_per_call=3
+    )
+    p_scan, stats = chunk(params0, scx, scy, scm, base, 2)
+
+    for a, b in zip(jax.tree.leaves(p_seq), jax.tree.leaves(p_scan)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(stats.mean_loss), np.asarray(seq_losses), atol=1e-5
+    )
+
+
+def test_trainer_rounds_per_call_equivalence():
+    """train_federated(rounds_per_call=2) reproduces the K=1 run exactly
+    (same seeds → same params/accuracy), with eval cadence respected."""
+    from qfedx_tpu.run.trainer import train_federated
+
+    num_clients, samples, n_q = 4, 8, 3
+    model = make_vqc_classifier(n_qubits=n_q, n_layers=1, num_classes=2)
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1,
+                    optimizer="adam")
+    rng = np.random.default_rng(1)
+    cx = rng.uniform(0, 1, (num_clients, samples, n_q)).astype(np.float32)
+    cy = rng.integers(0, 2, (num_clients, samples)).astype(np.int32)
+    cm = np.ones((num_clients, samples), dtype=np.float32)
+    tx = rng.uniform(0, 1, (16, n_q)).astype(np.float32)
+    ty = rng.integers(0, 2, (16,)).astype(np.int32)
+
+    kw = dict(num_rounds=4, seed=3, eval_every=2)
+    r1 = train_federated(model, cfg, cx, cy, cm, tx, ty, **kw)
+    r2 = train_federated(model, cfg, cx, cy, cm, tx, ty,
+                         rounds_per_call=2, **kw)
+    for a, b in zip(jax.tree.leaves(r1.params), jax.tree.leaves(r2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    assert r1.accuracies == r2.accuracies
+    np.testing.assert_allclose(r1.losses, r2.losses, atol=1e-5)

@@ -104,8 +104,8 @@ def test_routing(monkeypatch):
     monkeypatch.delenv("QFEDX_FUSED", raising=False)
     assert not fh.fused_eligible(7)  # needs a full 128-lane dim
     assert fh.fused_eligible(8)
-    assert fh.fused_eligible(18)
-    assert not fh.fused_eligible(19)  # VMEM working-set cap
+    assert fh.fused_eligible(16)
+    assert not fh.fused_eligible(17)  # compile-time cap (see MAX_QUBITS)
 
     class _Dev:
         def __init__(self, platform):
@@ -121,7 +121,7 @@ def test_routing(monkeypatch):
 
     monkeypatch.setenv("QFEDX_FUSED", "1")
     assert fh.fused_enabled(8)
-    assert not fh.fused_enabled(19)  # force cannot override eligibility
+    assert not fh.fused_enabled(17)  # force cannot override eligibility
     monkeypatch.setenv("QFEDX_FUSED", "0")
     monkeypatch.setattr(fh.jax, "devices", lambda: [_Dev("tpu")])
     assert not fh.fused_enabled(16)
