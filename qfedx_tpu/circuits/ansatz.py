@@ -59,14 +59,25 @@ def ansatz_layer(state: jnp.ndarray, rx_angles, rz_angles) -> jnp.ndarray:
     return _entangle_ring(state, n)
 
 
-def hardware_efficient(state: jnp.ndarray, params: dict) -> jnp.ndarray:
+def hardware_efficient(
+    state: jnp.ndarray, params: dict, remat: bool = False
+) -> jnp.ndarray:
     """L-layer hardware-efficient ansatz applied to an encoded state.
 
     params: {"rx": (L, n), "rz": (L, n)} from `init_ansatz_params`.
+
+    ``remat=True`` wraps each layer in ``jax.checkpoint``: reverse-mode
+    autodiff then stores one 2^n state per LAYER instead of one per GATE
+    (~2n fewer residuals) and recomputes the layer forward during the
+    backward pass — the standard FLOPs-for-HBM trade that keeps deep
+    circuits at 14+ qubits inside device memory.
     """
     n_layers = params["rx"].shape[0]
+    layer_fn = ansatz_layer
+    if remat:
+        layer_fn = jax.checkpoint(ansatz_layer)
     for layer in range(n_layers):
-        state = ansatz_layer(state, params["rx"][layer], params["rz"][layer])
+        state = layer_fn(state, params["rx"][layer], params["rz"][layer])
     return state
 
 
@@ -83,19 +94,35 @@ def init_reuploading_params(
     return base
 
 
-def data_reuploading(features: jnp.ndarray, params: dict) -> jnp.ndarray:
+def data_reuploading(
+    features: jnp.ndarray, params: dict, remat: bool = False
+) -> jnp.ndarray:
     """Data-reuploading circuit: [encode(w_l·x+b_l) → variational layer] × L.
 
     ``features`` in [0,1], shape (n,); the first encoding starts from |0…0⟩
     as a direct product state, later re-encodings are RY rotation banks.
+    ``remat=True`` checkpoints each re-encode+layer block (same trade as
+    `hardware_efficient`).
     """
     n_layers, n_qubits = params["rx"].shape
+
+    def block(state, angles, rx_l, rz_l):
+        for q in range(n_qubits):
+            state = apply_gate(state, gates.ry(angles[q]), q)
+        return ansatz_layer(state, rx_l, rz_l)
+
+    first_fn, block_fn = ansatz_layer, block
+    if remat:
+        first_fn = jax.checkpoint(ansatz_layer)
+        block_fn = jax.checkpoint(block)
+
     for layer in range(n_layers):
         angles = params["enc_w"][layer] * (features * jnp.pi) + params["enc_b"][layer]
         if layer == 0:
             state = product_state(angle_amplitudes(angles, "ry"))
+            state = first_fn(state, params["rx"][layer], params["rz"][layer])
         else:
-            for q in range(n_qubits):
-                state = apply_gate(state, gates.ry(angles[q]), q)
-        state = ansatz_layer(state, params["rx"][layer], params["rz"][layer])
+            state = block_fn(
+                state, angles, params["rx"][layer], params["rz"][layer]
+            )
     return state

@@ -55,12 +55,16 @@ def make_vqc_classifier(
     basis: str = "ry",
     init_scale: float = 0.1,
     noise_model=None,
+    remat: bool = False,
 ) -> Model:
     """Build the VQC classifier Model.
 
     Input features: shape (B, n_qubits) in [0,1] for angle/reupload
     encodings, (B, 2^n_qubits) for amplitude. ``noise_model``: optional
     ``noise.channels.NoiseModel`` applied between circuit and readout.
+    ``remat``: checkpoint each ansatz layer — autodiff residual memory
+    drops from one 2^n state per gate to one per layer (deep/wide
+    circuits; see circuits.ansatz.hardware_efficient).
     """
     if num_classes > n_qubits:
         raise ValueError(f"need n_qubits ≥ num_classes ({num_classes})")
@@ -90,9 +94,9 @@ def make_vqc_classifier(
 
     def forward_state(params, x):
         if encoding == "reupload":
-            return data_reuploading(x, params["ansatz"])
+            return data_reuploading(x, params["ansatz"], remat=remat)
         enc = angle_encode(x, basis) if encoding == "angle" else amplitude_encode(x)
-        return hardware_efficient(enc, params["ansatz"])
+        return hardware_efficient(enc, params["ansatz"], remat=remat)
 
     def apply_one(params, x, key=None):
         state = forward_state(params, x)
@@ -166,12 +170,13 @@ def make_vqc_classifier(
         from qfedx_tpu.circuits.ansatz import ansatz_layer
         from qfedx_tpu.noise.trajectory import apply_channel_all
 
+        layer_fn = jax.checkpoint(ansatz_layer) if remat else ansatz_layer
         enc = angle_encode(x, basis) if encoding == "angle" else amplitude_encode(x)
         state = enc
         channels = noise_model.kraus_channels()
         n_layers_ = params["ansatz"]["rx"].shape[0]
         for layer in range(n_layers_):
-            state = ansatz_layer(
+            state = layer_fn(
                 state, params["ansatz"]["rx"][layer], params["ansatz"]["rz"][layer]
             )
             for ci, kraus in enumerate(channels):

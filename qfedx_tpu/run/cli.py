@@ -64,6 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--damping", type=float, default=0.0)
     t.add_argument("--readout-flip", type=float, default=0.0)
     t.add_argument("--shots", type=int, default=None)
+    t.add_argument("--remat", action="store_true",
+                   help="checkpoint each ansatz layer (rematerialization): "
+                        "autodiff memory per sample O(layers)*2^n instead of "
+                        "O(gates)*2^n - for deep/wide dense circuits")
     t.add_argument("--noise-placement", default="readout",
                    choices=["readout", "circuit"],
                    help="analytic readout maps vs sampled Kraus trajectories in-circuit")
@@ -149,6 +153,7 @@ def config_from_args(a: argparse.Namespace) -> ExperimentConfig:
             readout_flip=a.readout_flip,
             shots=a.shots,
             noise_placement=a.noise_placement,
+            remat=a.remat,
         ),
         fed=FedConfig(
             local_epochs=a.local_epochs,

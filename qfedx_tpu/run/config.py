@@ -55,6 +55,9 @@ class ModelConfig:
     readout_flip: float = 0.0
     shots: int | None = None
     noise_placement: str = "readout"  # "readout" (analytic) | "circuit" (trajectory)
+    # Checkpoint each ansatz layer during autodiff (dense VQC): residual
+    # memory per sample drops from O(gates)·2^n to O(layers)·2^n.
+    remat: bool = False
 
 
 @dataclass(frozen=True)
@@ -151,6 +154,11 @@ def build_model(cfg: ExperimentConfig, num_classes: int):
                     "sv_size > 1 supports angle/amplitude encodings "
                     "(data reuploading is a dense-engine feature)"
                 )
+            if m.remat:
+                raise ValueError(
+                    "remat applies to the dense engine; the sv-sharded "
+                    "path (sv_size > 1) does not support it"
+                )
             return make_sharded_vqc_classifier(
                 n_qubits=m.n_qubits,
                 sv_size=m.sv_size,
@@ -165,6 +173,7 @@ def build_model(cfg: ExperimentConfig, num_classes: int):
             num_classes=num_classes,
             encoding=m.encoding,
             noise_model=noise_model,
+            remat=m.remat,
         )
     raise ValueError(f"unknown model {m.model!r}")
 

@@ -150,3 +150,46 @@ def test_grad_through_reuploading_circuit():
     g = jax.grad(loss)(params)
     total = sum(float(jnp.sum(jnp.abs(v))) for v in jax.tree.leaves(g))
     assert np.isfinite(total) and total > 0
+
+
+def test_remat_ansatz_matches_plain():
+    """jax.checkpoint-per-layer (remat) must not change values or grads."""
+    from qfedx_tpu.ops.statevector import expect_z_all
+
+    n, layers = 5, 3
+    params = init_ansatz_params(jax.random.PRNGKey(0), n, layers, scale=0.6)
+    x = jnp.linspace(0.1, 0.9, n)
+
+    def loss(p, remat):
+        state = hardware_efficient(angle_encode(x), p, remat=remat)
+        return jnp.sum(expect_z_all(state) * jnp.arange(1.0, n + 1))
+
+    np.testing.assert_allclose(
+        float(loss(params, False)), float(loss(params, True)), atol=1e-6
+    )
+    g0 = jax.grad(lambda p: loss(p, False))(params)
+    g1 = jax.grad(lambda p: loss(p, True))(params)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_remat_reupload_matches_plain():
+    """remat through the data-reuploading circuit: identical values/grads."""
+    from qfedx_tpu.circuits.ansatz import data_reuploading, init_reuploading_params
+    from qfedx_tpu.ops.statevector import expect_z_all
+
+    n, layers = 4, 3
+    params = init_reuploading_params(jax.random.PRNGKey(1), n, layers, scale=0.5)
+    x = jnp.linspace(0.2, 0.8, n)
+
+    def loss(p, remat):
+        state = data_reuploading(x, p, remat=remat)
+        return jnp.sum(expect_z_all(state) * jnp.arange(1.0, n + 1))
+
+    np.testing.assert_allclose(
+        float(loss(params, False)), float(loss(params, True)), atol=1e-6
+    )
+    g0 = jax.grad(lambda p: loss(p, False))(params)
+    g1 = jax.grad(lambda p: loss(p, True))(params)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
