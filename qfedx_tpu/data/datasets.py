@@ -33,6 +33,12 @@ SPECS = {
     "mnist": DatasetSpec("mnist", 28, 28, 1, 10),
     "fashion_mnist": DatasetSpec("fashion_mnist", 28, 28, 1, 10),
     "cifar10": DatasetSpec("cifar10", 32, 32, 3, 10),
+    # Iris (reference ROADMAP.md:102-105 names it alongside MNIST-PCA as
+    # the small-qubit evaluation dataset): 4 tabular features carried as
+    # 1×4 "images" so the whole pipeline contract applies unchanged.
+    # Quantum models use it directly (4 features ↔ 2–4 qubits); the CNN
+    # path is image-shaped and not meaningful here.
+    "iris": DatasetSpec("iris", 1, 4, 1, 3),
 }
 
 # MNIST/Fashion-MNIST raw filename convention (reference Preprocess.py:164-167).
@@ -72,6 +78,21 @@ def _try_load_cifar10(raw_folder: Path):
     return (np.concatenate(xs), np.concatenate(ys)), _read(test)
 
 
+def _load_iris(seed: int):
+    """Iris from the bundled table (data/_iris.py — no loader deps):
+    150×4 floats → uint8 in the (N, 1, 4) image contract (features span
+    ~0–8 cm, so /8·255 keeps ~0.03 cm resolution), stratified 120/30
+    split via the framework's own splitter."""
+    from qfedx_tpu.data._iris import iris_table
+    from qfedx_tpu.data.pipeline import stratified_split
+
+    x, y = iris_table()
+    x = np.clip(x / 8.0, 0.0, 1.0)
+    x = (x * 255.0).astype(np.uint8).reshape(-1, 1, 4)
+    (tr_x, tr_y), (te_x, te_y) = stratified_split(x, y, frac=0.2, seed=seed)
+    return (tr_x, tr_y), (te_x, te_y)
+
+
 def load_dataset(
     name: str = "mnist",
     raw_folder: str | Path | None = None,
@@ -84,11 +105,15 @@ def load_dataset(
 
     Tries real files under ``raw_folder`` first; falls back to the synthetic
     generator with identical shapes. Image layout: (N, H, W) for grayscale,
-    (N, H, W, C) for color.
+    (N, H, W, C) for color. Exception: ``iris`` is a real bundled table —
+    it always returns the fixed 120/30 stratified split, and the
+    raw_folder/synthetic_* knobs do not apply to it.
     """
     if name not in SPECS:
         raise ValueError(f"unknown dataset {name!r}; available: {sorted(SPECS)}")
     spec = SPECS[name]
+    if name == "iris":
+        return spec, *_load_iris(seed)
     if raw_folder is not None:
         raw = Path(raw_folder)
         loaded = (

@@ -134,6 +134,14 @@ def _matmul_lanes(x, m):
     return out.reshape(shape)
 
 
+def _matmul_lanes2(x, y, m):
+    """Both slabs through ONE (2·,128)×(128,128) matmul — halves the MXU
+    op count for the (very common) same-matrix re/im pair."""
+    xy = jnp.concatenate([x, y], axis=0)
+    out = _matmul_lanes(xy, m)
+    return out[: x.shape[0]], out[x.shape[0] :]
+
+
 def _rot_entries(theta, phi):
     """rot_zx = RZ(φ)·RX(θ) real/imag 2×2 entries (ops.gates.rot_zx)."""
     c, s = jnp.cos(theta * 0.5), jnp.sin(theta * 0.5)
@@ -200,8 +208,8 @@ def _apply_rot(x, y, n: int, q: int, ur, ui):
     p = _lane_bitpos(n, q)
     mr = _lane_gate_matrix(p, u00r, u01r, u10r, u11r)
     mi = _lane_gate_matrix(p, u00i, u01i, u10i, u11i)
-    xr, xi_ = _matmul_lanes(x, mr), _matmul_lanes(x, mi)
-    yr, yi_ = _matmul_lanes(y, mr), _matmul_lanes(y, mi)
+    xr, yr = _matmul_lanes2(x, y, mr)
+    xi_, yi_ = _matmul_lanes2(x, y, mi)
     return xr - yi_, yr + xi_
 
 
@@ -243,6 +251,18 @@ def _apply_cnot_one(x, n: int, c: int, t: int):
 
 
 def _apply_cnot(x, y, n: int, c: int, t: int):
+    """CNOT on the (re, im) pair; the lane-permutation cases run both
+    slabs through one stacked matmul (the matrix is real)."""
+    nrow = n - LANE_QUBITS
+    c_row, t_row = c < nrow, t < nrow
+    if c_row and not t_row:  # lanes flip where control=1: stack halves
+        pf = _lane_perm_flip(_lane_bitpos(n, t))
+        xs, ys = _split_row(x, n, c), _split_row(y, n, c)
+        x1, y1 = _matmul_lanes2(xs[:, :, 1], ys[:, :, 1], pf)
+        return _join_row(xs[:, :, 0], x1), _join_row(ys[:, :, 0], y1)
+    if (not c_row) and (not t_row):  # both lanes: one stacked perm matmul
+        mt = _lane_perm_cnot(_lane_bitpos(n, c), _lane_bitpos(n, t))
+        return _matmul_lanes2(x, y, mt)
     return _apply_cnot_one(x, n, c, t), _apply_cnot_one(y, n, c, t)
 
 

@@ -36,7 +36,7 @@ def build_parser() -> argparse.ArgumentParser:
     t = sub.add_parser("train", help="run federated training")
     # data
     t.add_argument("--dataset", default="mnist",
-                   choices=["mnist", "fashion_mnist", "cifar10"])
+                   choices=["mnist", "fashion_mnist", "cifar10", "iris"])
     t.add_argument("--raw-folder", default=None,
                    help="folder with IDX/CIFAR files; synthetic fallback if absent")
     t.add_argument("--classes", default="0,1,2",

@@ -212,6 +212,16 @@ def build_data(cfg: ExperimentConfig) -> dict[str, Any]:
         seed=d.seed,
     )
     tr_x, tr_y = prep.train
+    if is_quantum and tr_x.shape[-1] != n_features:
+        # PCA caps components at the raw feature count silently; training
+        # an "8-qubit" model on 4 features would leave half the ansatz
+        # with zero gradient (dead parameters) — reject loudly instead.
+        raise ValueError(
+            f"dataset produces {tr_x.shape[-1]} features but the "
+            f"{m.n_qubits}-qubit model needs {n_features} "
+            f"({m.encoding} encoding); lower --qubits to "
+            f"{tr_x.shape[-1]} or pick a wider dataset/feature mode"
+        )
     if d.partition == "dirichlet":
         parts = dirichlet_partition(tr_y, d.num_clients, d.alpha, seed=d.seed)
     elif d.partition == "iid":
