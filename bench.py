@@ -382,7 +382,9 @@ def main():
         jax, model, cfg, mesh, num_clients, data, make_fed_round, shard_client_data
     )
     seq_s = _time_sequential(jax, model, cfg, num_clients, data, make_local_update)
-    scan_k = 10
+    # Scan depth measured on v5e: 10 → 331/s, 20 → 395/s, 40 → 435/s per
+    # chip (diminishing past that); training is bit-identical at any K.
+    scan_k = 40
     try:
         scan_s = _time_spmd_scanned(
             jax, model, cfg, mesh, num_clients, data, shard_client_data,

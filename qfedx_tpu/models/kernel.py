@@ -10,9 +10,12 @@ and CNN (reference ROADMAP.md:109's apples-to-apples requirement; the
 reference itself has no kernel code — this implements the driver's config-5
 capability on the fidelity primitive ops.statevector.fidelity).
 
-Gram rows are one ``vmap`` over landmarks inside one ``vmap`` over the
-batch — 2^n-length dot products that XLA batches onto the MXU; no pairwise
-Python loops.
+The angle-encoded feature map is a product state, so the default
+``kernel_matrix`` computes the Gram matrix in CLOSED FORM — a per-qubit
+cos² product, O(n) per pair with no statevector anywhere (20+ qubit
+heads need no sharding). ``kernel_matrix_dense`` keeps the explicit
+2^n-statevector construction as the general-basis path and the exactness
+oracle the closed form is tested against.
 """
 
 from __future__ import annotations
@@ -30,8 +33,14 @@ def _feature_state(x: jnp.ndarray, basis: str) -> CArray:
     return angle_encode(x, basis)
 
 
-def kernel_matrix(xs: jnp.ndarray, ys: jnp.ndarray, basis: str = "ry") -> jnp.ndarray:
-    """Gram matrix K[i, j] = |⟨φ(xs_i)|φ(ys_j)⟩|², shapes (B, n)×(M, n)→(B, M)."""
+def kernel_matrix_dense(
+    xs: jnp.ndarray, ys: jnp.ndarray, basis: str = "ry"
+) -> jnp.ndarray:
+    """Gram matrix via explicit statevectors — O((B+M)·2^n) memory.
+
+    Kept as the general-basis implementation and as the exactness oracle
+    for ``kernel_matrix``'s closed form (tested equal).
+    """
     # Encode each side once (O((B+M)·2^n)), not per pair: the landmark
     # states are reused across every batch row.
     sy = jax.vmap(lambda y: _feature_state(y, basis))(ys)
@@ -41,6 +50,27 @@ def kernel_matrix(xs: jnp.ndarray, ys: jnp.ndarray, basis: str = "ry") -> jnp.nd
         return jax.vmap(lambda s: fidelity(sx, s))(sy)
 
     return jax.vmap(row)(xs)
+
+
+def kernel_matrix(xs: jnp.ndarray, ys: jnp.ndarray, basis: str = "ry") -> jnp.ndarray:
+    """Gram matrix K[i, j] = |⟨φ(xs_i)|φ(ys_j)⟩|², shapes (B, n)×(M, n)→(B, M).
+
+    The angle-encoded feature map is a PRODUCT state, so its fidelity
+    factorizes per qubit: for RY (and RX) encoding,
+
+        ⟨φ(x)|φ(y)⟩ = Π_k cos(π(x_k − y_k)/2)   ⇒   K = Π_k cos²(·)
+
+    — O(n) per pair instead of O(2^n), with no statevector anywhere. A
+    20-qubit (or 2000-qubit) kernel head is a single broadcast
+    cos-product on the VPU (BASELINE.md config 5's 20-qubit head needs
+    no sharding at all). ``kernel_matrix_dense`` is the tested oracle.
+    """
+    if basis not in ("ry", "rx"):
+        # rz encodes a global phase (fidelity ≡ 1); any future basis with
+        # entangling structure would not factorize — fall back to states.
+        return kernel_matrix_dense(xs, ys, basis)
+    half = 0.5 * jnp.pi * (xs[:, None, :] - ys[None, :, :])  # (B, M, n)
+    return jnp.prod(jnp.square(jnp.cos(half)), axis=-1)
 
 
 def make_quantum_kernel_classifier(
