@@ -98,7 +98,8 @@ def preset_cells(preset: str) -> list[dict]:
                   prox_mu=0.01, rounds=4),
             _cell("c4-12q-reupload-secagg", qubits=12, clients=64,
                   encoding="reupload", secure_agg=True, rounds=4),
-            _cell("c5-svqc", qubits=8, clients=32, sv_size=4, rounds=4),
+            _cell("c5-svqc", qubits=8, clients=32, sv_size=4, rounds=6,
+                  classes=(0, 1)),
             _cell("c5-qkernel20", model="qkernel", qubits=20, clients=32,
                   rounds=4),
         ]
