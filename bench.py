@@ -26,9 +26,6 @@ Extra fields (round-2 VERDICT items 1 and 5):
   arithmetic intensity ~1 FLOP/byte, so the op is HBM-bound by
   construction and the bandwidth figure is the meaningful one; the MXU
   FLOP number is reported to show WHY (it is single-digit % at best).
-- ``pallas``: the same compute-bound program with QFEDX_PALLAS=1 (the
-  fused streaming kernel, ops/pallas_gates.py) vs the default XLA path —
-  the on/off decision for the routing threshold is made from this data.
 - ``time_to_target``: wall-clock to a fixed accuracy on the learnable
   synthetic set — the second half of the north-star metric.
 """
@@ -196,22 +193,23 @@ _PEAK_F32_FLOPS = 49.2e12  # v5e MXU fp32 (bf16 peak 197 TF / 4)
 _PEAK_HBM_BPS = 819e9  # v5e HBM bandwidth
 
 
-def _dense_cost_model(n_qubits: int, n_layers: int):
+def _dense_cost_model(n_qubits: int, n_layers: int, state_bytes: int = 4):
     """(gates, est FLOPs, est HBM bytes) per sample-forward, from the
     engine's real-pair contraction structure (ops/statevector.py).
 
     Fused RZ·RX rotation (complex 2×2): 4 real (2,2)×(2,2^{n-1})
     contractions ≈ 16·2^n FLOPs + 2·2^n combine adds. CNOT (real 4×4, state
     complex): 2 real (4,4)×(4,2^{n-2}) contractions ≈ 16·2^n FLOPs. Every
-    gate streams the full re+im state from HBM and back: ≈ 16·2^n bytes
-    (f32), the op's true cost at this arithmetic intensity.
+    gate streams the full re+im state from HBM and back: ≈ 4·state_bytes·2^n
+    bytes (state_bytes = 4 for f32, 2 for QFEDX_DTYPE=bf16), the op's true
+    cost at this arithmetic intensity.
     """
     amps = 1 << n_qubits
     rot_gates = n_layers * n_qubits
     cnot_gates = n_layers * n_qubits  # ring
     gates = rot_gates + cnot_gates
     flops = rot_gates * 18 * amps + cnot_gates * 16 * amps
-    bytes_ = gates * 16 * amps
+    bytes_ = gates * 4 * state_bytes * amps
     return gates, flops, bytes_
 
 
@@ -232,7 +230,7 @@ def _with_env(env: dict, fn, *a, **k):
 
 
 def _bench_compute_bound(jax, n_qubits=16, n_layers=3, batch=64, reps=5,
-                         steps=8):
+                         steps=8, remat=False):
     """Batched forward+grad of the dense n-qubit VQC — simulation-dominated
     (2^16 amplitudes/sample × 96 gates ≫ dispatch). ``steps`` gradient
     steps run inside ONE jitted lax.scan so device time dominates the
@@ -240,13 +238,19 @@ def _bench_compute_bound(jax, n_qubits=16, n_layers=3, batch=64, reps=5,
     ~100ms latency, comparable to one whole fwd+grad, which un-amortized
     flattened every timing to the latency floor. Utilization estimates
     take backward ≈ 2× forward cost (adjoint state pass + gate-parameter
-    reductions)."""
+    reductions). Honors QFEDX_DTYPE for the HBM-byte estimate."""
+    import os
+
     import jax.numpy as jnp
     import optax
 
     from qfedx_tpu.models.vqc import make_vqc_classifier
 
-    model = make_vqc_classifier(n_qubits=n_qubits, n_layers=n_layers, num_classes=2)
+    state_bytes = (
+        2 if os.environ.get("QFEDX_DTYPE", "") in ("bf16", "bfloat16") else 4
+    )
+    model = make_vqc_classifier(n_qubits=n_qubits, n_layers=n_layers,
+                                num_classes=2, remat=remat)
     params = model.init(jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.uniform(0, 1, (batch, n_qubits)), dtype=jnp.float32)
@@ -285,7 +289,9 @@ def _bench_compute_bound(jax, n_qubits=16, n_layers=3, batch=64, reps=5,
     if t < 1e-3:
         t = measure()
 
-    gates, fwd_flops, fwd_bytes = _dense_cost_model(n_qubits, n_layers)
+    gates, fwd_flops, fwd_bytes = _dense_cost_model(
+        n_qubits, n_layers, state_bytes
+    )
     total_flops = 3 * batch * fwd_flops  # fwd + ~2x bwd
     total_bytes = 3 * batch * fwd_bytes
     amps = 1 << n_qubits
@@ -300,21 +306,6 @@ def _bench_compute_bound(jax, n_qubits=16, n_layers=3, batch=64, reps=5,
         "est_hbm_gbps": round(total_bytes / t / 1e9, 1),
         "est_hbm_util": round(total_bytes / t / _PEAK_HBM_BPS, 3),
     }
-
-
-def _bench_pallas(jax, n_qubits=16, n_layers=3, batch=64):
-    """The same compute-bound program with the per-gate Pallas kernel
-    routed in (QFEDX_PALLAS=1, fused off) vs the plain XLA path."""
-    if jax.devices()[0].platform == "cpu":
-        return {"skipped": "pallas kernel needs TPU (interpret mode is test-only)"}
-    try:
-        on = _with_env(
-            {"QFEDX_PALLAS": "1", "QFEDX_FUSED": "0"},
-            _bench_compute_bound, jax, n_qubits, n_layers, batch,
-        )
-    except Exception as e:  # noqa: BLE001 — report, don't kill the bench
-        return {"error": f"{type(e).__name__}: {e}"}
-    return {"fwd_grad_s": on["fwd_grad_s"], "est_hbm_gbps": on["est_hbm_gbps"]}
 
 
 def _bench_fused(jax, n_qubits=16, n_layers=3, batch=64):
@@ -400,20 +391,52 @@ def main():
             return {"error": f"{type(e).__name__}: {e}"}
 
     # Baseline XLA path measured with the fused auto-route pinned off, so
-    # the three rows are the three engines, not "whatever auto picked".
+    # the rows are the engines, not "whatever auto picked".
     compute = safe(
         lambda j: _with_env({"QFEDX_FUSED": "0"}, _bench_compute_bound, j)
     )
-    pallas = safe(_bench_pallas)
     fused = safe(_bench_fused)
-    if "fwd_grad_s" in compute and "fwd_grad_s" in pallas:
-        pallas["speedup_vs_xla"] = round(
-            compute["fwd_grad_s"] / pallas["fwd_grad_s"], 3
-        )
     if "fwd_grad_s" in compute and "fwd_grad_s" in fused:
         fused["speedup_vs_xla"] = round(
             compute["fwd_grad_s"] / fused["fwd_grad_s"], 3
         )
+    # bf16 state path (QFEDX_DTYPE=bf16): halves HBM traffic on the
+    # HBM-bound gate stream; fused additionally runs lane-gate matmuls on
+    # the MXU in bf16/f32-accumulate. Convergence parity is pinned by
+    # tests/test_bf16.py.
+    compute_bf16 = safe(
+        lambda j: _with_env(
+            {"QFEDX_FUSED": "0", "QFEDX_DTYPE": "bf16"},
+            _bench_compute_bound, j,
+        )
+    )
+    def _fused_bf16(j):
+        if j.devices()[0].platform == "cpu":
+            return {"skipped": "needs TPU"}
+        on = _with_env(
+            {"QFEDX_FUSED": "1", "QFEDX_DTYPE": "bf16"},
+            _bench_compute_bound, j,
+        )
+        # Strip the streaming-cost-model fields (like _bench_fused does):
+        # the fused kernel makes O(1) HBM passes, so per-gate byte
+        # estimates would report nonsense bandwidth for it.
+        return {"fwd_grad_s": on["fwd_grad_s"]}
+
+    fused_bf16 = safe(_fused_bf16)
+    for row in (compute_bf16, fused_bf16):
+        if "fwd_grad_s" in row and "fwd_grad_s" in compute:
+            row["speedup_vs_xla_f32"] = round(
+                compute["fwd_grad_s"] / row["fwd_grad_s"], 3
+            )
+    # The 18–20-qubit dense frontier (reference ROADMAP.md:86), measured on
+    # the real chip: 20-qubit 3-layer XLA path with per-layer remat (the
+    # autodiff tape at 2^20 amps × 120 gates would not fit HBM otherwise).
+    dense20 = safe(
+        lambda j: _with_env(
+            {"QFEDX_FUSED": "0"}, _bench_compute_bound, j,
+            20, 3, 8, 3, 4, True,
+        )
+    )
     ttt = safe(_bench_time_to_target)
 
     # Headline: the trainer's optimized path (K rounds scanned per
@@ -428,12 +451,23 @@ def main():
                 "metric": "vqc_client_rounds_per_sec_per_chip",
                 "value": round(value, 3),
                 "unit": "client-rounds/s/chip",
+                # Headline ratio compares the K-round scanned dispatch
+                # against the reference's sequential per-round architecture
+                # (dispatch amortization included, by design — both run the
+                # same training); the per-dispatch ratio alongside is the
+                # apples-to-apples single-round comparison.
                 "vs_baseline": round(value / baseline_value, 3),
+                "vs_baseline_note": "scanned(K) vs sequential per-round loop",
+                "per_dispatch_vs_baseline": round(
+                    per_dispatch / baseline_value, 3
+                ),
                 "rounds_per_call": scan_k,
                 "per_dispatch_value": round(per_dispatch, 3),
                 "compute_bound": compute,
-                "pallas": pallas,
                 "fused": fused,
+                "compute_bound_bf16": compute_bf16,
+                "fused_bf16": fused_bf16,
+                "dense20q": dense20,
                 "time_to_target": ttt,
             }
         )

@@ -2,9 +2,9 @@
 
 Usage: python benchmarks/fused_sweep.py [n_qubits ...]
 Prints one JSON line per config: fwd+grad seconds per step for the
-default path, QFEDX_PALLAS=1 (per-gate kernel) and QFEDX_FUSED=1 (whole-
-circuit kernel), with speedups. This is the data behind the fused
-routing default (ops.fused_hea.AUTO_MIN_QUBITS).
+default XLA path and QFEDX_FUSED=1 (whole-circuit kernel), with the
+speedup. This is the data behind the fused routing default
+(ops.fused_hea.AUTO_MIN_QUBITS).
 """
 
 from __future__ import annotations
@@ -82,16 +82,23 @@ def main():
         row = {"n_qubits": n, "n_layers": 3, "batch": 64}
         try:
             row["xla_s"] = round(with_env("QFEDX_FUSED", "0", timeit, n), 5)
-            row["pallas_gate_s"] = round(
-                with_env("QFEDX_PALLAS", "1",
+            row["fused_s"] = round(with_env("QFEDX_FUSED", "1", timeit, n), 5)
+            row["fused_speedup_vs_xla"] = round(row["xla_s"] / row["fused_s"], 3)
+            row["fused_bf16_s"] = round(
+                with_env("QFEDX_DTYPE", "bf16",
+                         lambda m: with_env("QFEDX_FUSED", "1", timeit, m), n),
+                5,
+            )
+            row["xla_bf16_s"] = round(
+                with_env("QFEDX_DTYPE", "bf16",
                          lambda m: with_env("QFEDX_FUSED", "0", timeit, m), n),
                 5,
             )
-            row["fused_s"] = round(with_env("QFEDX_FUSED", "1", timeit, n), 5)
-            row["fused_speedup_vs_xla"] = round(row["xla_s"] / row["fused_s"], 3)
-            row["fused_speedup_vs_pallas_gate"] = round(
-                row["pallas_gate_s"] / row["fused_s"], 3
+            row["fused_bf16_speedup_vs_xla_f32"] = round(
+                row["xla_s"] / row["fused_bf16_s"], 3
             )
+            if os.environ.get("QFEDX_FUSED_BB"):
+                row["bb"] = int(os.environ["QFEDX_FUSED_BB"])
         except Exception as e:  # noqa: BLE001 — report per-config
             row["error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(row), flush=True)

@@ -21,14 +21,18 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from qfedx_tpu.ops.cpx import CArray, RDTYPE
+from qfedx_tpu.ops.cpx import CArray, state_dtype
 from qfedx_tpu.ops.statevector import product_state
 
 
 def angle_amplitudes(angles: jnp.ndarray, basis: str = "ry") -> CArray:
-    """Per-qubit 2-vectors for R_basis(angle)|0⟩; angles shape (n,) → (n, 2)."""
+    """Per-qubit 2-vectors for R_basis(angle)|0⟩; angles shape (n,) → (n, 2).
+
+    cos/sin run in f32; the 2-vectors are cast to the state dtype so the
+    product state (and everything downstream) carries QFEDX_DTYPE."""
     half = angles / 2.0
-    c, s = jnp.cos(half), jnp.sin(half)
+    c = jnp.cos(half).astype(state_dtype())
+    s = jnp.sin(half).astype(state_dtype())
     if basis == "ry":
         # RY(θ)|0⟩ = [cos θ/2, sin θ/2] — real.
         return CArray(jnp.stack([c, s], axis=-1), None)
@@ -60,12 +64,12 @@ def amplitude_encode(x: jnp.ndarray) -> CArray:
     All-zero input falls back to the uniform superposition (reference
     qAmplitude.py:17-21), expressed branch-free so it vmaps/jits.
     """
-    x = jnp.asarray(x, dtype=RDTYPE)
+    x = jnp.asarray(x, dtype=jnp.float32)
     size = x.shape[-1]
     n = size.bit_length() - 1
     if 1 << n != size:
         raise ValueError(f"amplitude encoding needs 2^n features, got {size}")
-    norm = jnp.linalg.norm(x)
-    uniform = jnp.full((size,), 1.0 / jnp.sqrt(size), dtype=RDTYPE)
+    norm = jnp.linalg.norm(x)  # normalize in f32, then cast the state
+    uniform = jnp.full((size,), 1.0 / jnp.sqrt(size), dtype=jnp.float32)
     safe = jnp.where(norm > 0, x / jnp.where(norm > 0, norm, 1.0), uniform)
-    return CArray(safe.reshape((2,) * n), None)
+    return CArray(safe.reshape((2,) * n).astype(state_dtype()), None)

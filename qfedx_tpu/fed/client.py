@@ -69,6 +69,63 @@ def make_spsa_grad(loss_fn, c: float):
     return spsa_grad
 
 
+def _make_dp_example_grad(model: Model, cfg: FedConfig):
+    """Per-example DP-SGD gradient (BASELINE.md config 2; reference
+    ROADMAP.md:50-58; SURVEY §7.3 hard-part 4: "per-example … clipping
+    inside vmap").
+
+    The batch gradient is the Abadi et al. estimator with lot size B:
+
+        g̃ = ( Σ_i min(1, C/‖g_i‖)·m_i·g_i  +  N(0, σ²C²I) ) / B
+
+    — every example's gradient clipped to C inside a ``vmap`` (B copies of
+    a params-sized grad live at once; fine for VQC/TinyCNN scales), one
+    fresh noise draw per local step from the per-(client, step) key
+    stream. Padded examples (m_i = 0) contribute nothing; B stays the
+    static lot size, so padding never changes the noise scale. The
+    FedProx proximal gradient is data-independent and is added OUTSIDE
+    the clipped sum — it shifts every example's gradient identically and
+    does not change the per-example sensitivity.
+    """
+    dp = cfg.dp
+
+    def ex_loss(params, xi, yi, key):
+        xb = xi[None]
+        if model.apply_train is not None:
+            logits = model.apply_train(params, xb, key)
+        else:
+            logits = model.apply(params, xb)
+        return optax.softmax_cross_entropy_with_integer_labels(logits[0], yi)
+
+    def grad_fn(params, global_params, xb, yb, mb, key):
+        k_noise, k_fwd = jax.random.split(jax.random.fold_in(key, 0xDE5))
+        ex_keys = jax.random.split(k_fwd, xb.shape[0])
+        losses, grads = jax.vmap(
+            lambda xi, yi, k: jax.value_and_grad(ex_loss)(params, xi, yi, k)
+        )(xb, yb, ex_keys)
+        norms = jax.vmap(trees.global_norm)(grads)
+        factor = jnp.minimum(1.0, dp.clip_norm / jnp.maximum(norms, 1e-12)) * mb
+        clipped_sum = jax.tree.map(
+            lambda g: jnp.tensordot(factor, g, axes=1), grads
+        )
+        noise = trees.tree_random_normal(k_noise, params)
+        lot = float(xb.shape[0])
+        gmean = jax.tree.map(
+            lambda s, z: (s + dp.noise_multiplier * dp.clip_norm * z) / lot,
+            clipped_sum,
+            noise,
+        )
+        if cfg.algorithm == "fedprox":
+            gmean = jax.tree.map(
+                lambda g, p, gp: g + cfg.prox_mu * (p - gp),
+                gmean, params, global_params,
+            )
+        loss = jnp.sum(losses * mb) / jnp.maximum(jnp.sum(mb), 1.0)
+        return loss, gmean
+
+    return grad_fn
+
+
 def make_local_update(model: Model, cfg: FedConfig) -> Callable:
     """Build ``local_update(global_params, x, y, mask, key)``.
 
@@ -90,7 +147,9 @@ def make_local_update(model: Model, cfg: FedConfig) -> Callable:
             loss = loss + 0.5 * cfg.prox_mu * prox
         return loss
 
-    if cfg.optimizer == "spsa":
+    if cfg.dp is not None and cfg.dp.mode == "example":
+        grad_fn = _make_dp_example_grad(model, cfg)
+    elif cfg.optimizer == "spsa":
         grad_fn = make_spsa_grad(loss_fn, cfg.spsa_c)
     else:
         grad_fn = jax.value_and_grad(loss_fn)

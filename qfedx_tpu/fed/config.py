@@ -14,12 +14,29 @@ from dataclasses import dataclass, field
 
 @dataclass(frozen=True)
 class DPConfig:
-    """Differential privacy: clip Δθ to ℓ2 norm C, add N(0, σ²C²I)
-    (reference ROADMAP.md:50-51,140-141)."""
+    """Differential privacy (reference ROADMAP.md:50-51,140-141).
+
+    Two granularities (``mode``):
+
+    - ``"client"`` — DP-FedAvg: clip each client's whole update Δθ to ℓ2
+      norm C and add N(0, σ²C²I) once per round (fed.privacy.privatize).
+      Protects client membership; one accountant step per round at
+      q = client_fraction.
+    - ``"example"`` — DP-SGD (BASELINE.md config 2; SURVEY §7.3 hard-part
+      4): clip every *example's* gradient to C inside each local step and
+      noise the per-batch mean (fed.client per-example grad). Protects
+      example membership; the accountant composes one step per LOCAL
+      step at q ≈ client_fraction · batch/|client dataset|.
+    """
 
     clip_norm: float = 1.0
     noise_multiplier: float = 1.0
     delta: float = 1e-5  # reporting δ (ROADMAP.md:113)
+    mode: str = "client"  # "client" (DP-FedAvg) | "example" (DP-SGD)
+
+    def __post_init__(self):
+        if self.mode not in ("client", "example"):
+            raise ValueError(f"unknown dp mode {self.mode!r}")
 
 
 @dataclass(frozen=True)
@@ -61,3 +78,12 @@ class FedConfig:
             raise ValueError(f"unknown secure_agg_mode {self.secure_agg_mode!r}")
         if self.secure_agg_neighbors < 1:
             raise ValueError("secure_agg_neighbors must be ≥ 1")
+        if (
+            self.dp is not None
+            and self.dp.mode == "example"
+            and self.optimizer == "spsa"
+        ):
+            # SPSA's 2-evaluation estimator has no per-example gradients
+            # to clip — the DP-SGD sensitivity analysis doesn't apply.
+            raise ValueError("per-example DP (dp mode='example') requires a "
+                             "gradient optimizer (sgd/adam), not spsa")

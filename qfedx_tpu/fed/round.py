@@ -82,7 +82,15 @@ def make_fed_round(
                 params, x, y, m, jax.random.fold_in(train_key, cid)
             )
             if cfg.dp is not None:
-                delta = privatize(delta, cfg.dp, jax.random.fold_in(dp_key, cid))
+                if cfg.dp.mode == "client":
+                    delta = privatize(
+                        delta, cfg.dp, jax.random.fold_in(dp_key, cid)
+                    )
+                # mode == "example": the update is already private (per-
+                # example clip+noise inside local steps, fed.client);
+                # clipping it again here would break the DP-SGD noise
+                # calibration. Weights stay uniform under either mode —
+                # sample-count weighting leaks dataset sizes.
                 weight = jnp.minimum(n, 1.0) if cfg.dp_uniform_weights else n
             else:
                 weight = n
@@ -138,6 +146,7 @@ def make_fed_rounds(
     num_clients: int,
     rounds_per_call: int,
     axis: str = "clients",
+    with_eval: bool = False,
 ):
     """K federated rounds in ONE dispatch: ``lax.scan`` over the round body.
 
@@ -149,12 +158,43 @@ def make_fed_rounds(
     derives its key as ``fold_in(round_key_base, start_round + i)`` —
     exactly the trainer's per-round derivation.
 
-    Returns ``rounds_fn(params, cx, cy, cmask, round_key_base,
-    start_round) -> (params, stats)`` with each ``stats`` leaf stacked
-    over the K rounds. ``start_round`` may be a traced int32 (no
-    recompile across chunks).
+    ``with_eval=False`` returns ``rounds_fn(params, cx, cy, cmask,
+    round_key_base, start_round) -> (params, stats)`` with each ``stats``
+    leaf stacked over the K rounds. ``start_round`` may be a traced int32
+    (no recompile across chunks).
+
+    ``with_eval=True`` (round-2 VERDICT item 6): evaluation joins the
+    scanned program — ``rounds_fn(..., start_round, eval_x, eval_y) ->
+    (params, (stats, accuracies))`` computes test accuracy ON DEVICE after
+    every scanned round (deterministic ``model.apply``), so per-round
+    accuracy reporting no longer costs a host round-trip per round and
+    ``rounds_per_call`` no longer trades against ``eval_every``. Only for
+    host-callable models (``model.sv_size == 1``); the sharded-VQC path
+    keeps host-side evaluation via ``vqc_sharded.host_apply``.
     """
     one_round = make_fed_round(model, cfg, mesh, num_clients, axis=axis)
+
+    if with_eval:
+        if model.sv_size != 1:
+            raise ValueError("with_eval=True needs a host-callable model "
+                             "(sv_size == 1)")
+
+        def rounds_fn(params, cx, cy, cmask, round_key_base, start_round,
+                      eval_x, eval_y):
+            def body(p, i):
+                rk = jax.random.fold_in(round_key_base, start_round + i)
+                p2, stats = one_round(p, cx, cy, cmask, rk)
+                logits = model.apply(p2, eval_x)
+                acc = jnp.mean(
+                    (jnp.argmax(logits, axis=-1) == eval_y).astype(jnp.float32)
+                )
+                return p2, (stats, acc)
+
+            return jax.lax.scan(
+                body, params, jnp.arange(rounds_per_call, dtype=jnp.int32)
+            )
+
+        return jax.jit(rounds_fn)
 
     def rounds_fn(params, cx, cy, cmask, round_key_base, start_round):
         def body(p, i):

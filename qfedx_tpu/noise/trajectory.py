@@ -45,7 +45,9 @@ def apply_channel(
     """
     n_k = kraus.re.shape[0]
     outs = [sv.apply_gate(state, _kraus_op(kraus, i), qubit) for i in range(n_k)]
-    probs = jnp.stack([jnp.sum(sv.cabs2(o)) for o in outs])
+    # Born weights in f32 (bf16 sums over 2^n terms would swamp the
+    # branch probabilities).
+    probs = jnp.stack([jnp.sum(sv.cabs2(o), dtype=jnp.float32) for o in outs])
     idx = jax.random.categorical(key, jnp.log(jnp.maximum(probs, 1e-30)))
 
     any_im = any(o.im is not None for o in outs)
@@ -55,7 +57,7 @@ def apply_channel(
         if any_im
         else None
     )
-    norm = jnp.sqrt(jnp.maximum(jnp.take(probs, idx), 1e-30))
+    norm = jnp.sqrt(jnp.maximum(jnp.take(probs, idx), 1e-30)).astype(re.dtype)
     return CArray(re / norm, None if im is None else im / norm)
 
 

@@ -16,12 +16,30 @@ time at zero runtime cost.
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple
 
 import jax.numpy as jnp
 import numpy as np
 
 RDTYPE = jnp.float32
+
+
+def state_dtype():
+    """dtype of statevector slabs: QFEDX_DTYPE=bf16 halves HBM traffic.
+
+    Gate application is ~1 FLOP/byte — HBM-bound on any accelerator — so
+    moving fewer bytes is the dominant lever in the dense regime
+    (BENCH_r02: ~60% HBM utilization at f32). Under bf16 the *states*
+    carry bf16 while parameters, gate construction (cos/sin of f32
+    angles, cast at apply time), and every reduction/readout accumulate
+    in f32 (``jnp.sum(..., dtype=f32)``), the bf16-state/f32-accumulate
+    recipe. Read at trace time; f32 is the default."""
+    return (
+        jnp.bfloat16
+        if os.environ.get("QFEDX_DTYPE", "float32") in ("bf16", "bfloat16")
+        else jnp.float32
+    )
 
 
 class CArray(NamedTuple):
@@ -95,13 +113,18 @@ def cmul(a: CArray, b: CArray) -> CArray:
 
 
 def vdot(a: CArray, b: CArray) -> CArray:
-    """⟨a|b⟩ = Σ conj(a)·b over all axes → complex scalar CArray."""
+    """⟨a|b⟩ = Σ conj(a)·b over all axes → complex scalar CArray.
+
+    Accumulates in f32 regardless of state dtype (bf16 sums over 2^n
+    terms would lose the result entirely)."""
     a_re, b_re = a.re, b.re
-    rr = jnp.sum(a_re * b_re)
+    rr = jnp.sum(a_re * b_re, dtype=jnp.float32)
     if a.im is None and b.im is None:
         return CArray(rr, None)
     a_im = a.imag_or_zeros()
     b_im = b.imag_or_zeros()
-    re = rr + jnp.sum(a_im * b_im)
-    im = jnp.sum(a_re * b_im) - jnp.sum(a_im * b_re)
+    re = rr + jnp.sum(a_im * b_im, dtype=jnp.float32)
+    im = jnp.sum(a_re * b_im, dtype=jnp.float32) - jnp.sum(
+        a_im * b_re, dtype=jnp.float32
+    )
     return CArray(re, im)

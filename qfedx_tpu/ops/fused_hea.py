@@ -1,8 +1,7 @@
 """Fused whole-circuit Pallas kernel for the hardware-efficient VQC.
 
 Statevector gate application is ~1 FLOP/byte, so the per-gate engine
-(ops.statevector, even with the per-gate Pallas kernel in
-ops.pallas_gates) is HBM-bound: every gate streams the full 2^n state
+(ops.statevector) is HBM-bound: every gate streams the full 2^n state
 from HBM and back — ~2·L·n round trips per forward. This kernel fuses
 the ENTIRE circuit — angle-encoded product state in, ⟨Z_k⟩ readout out —
 into one `pallas_call` that keeps the state resident in VMEM across all
@@ -63,6 +62,18 @@ MAX_QUBITS = 16
 AUTO_MIN_QUBITS = 16
 
 _INTERPRET = False  # flipped by tests on CPU
+# Trace-time flag (set by the host wrappers while tracing a kernel whose
+# HBM slabs are bf16, unless QFEDX_MXU_BF16=0): lane-qubit matmuls then
+# run the MXU in bf16 with f32 accumulation — 4× the f32 MXU rate — while
+# VPU row-gate arithmetic stays f32. Re-rounding the state at each lane
+# gate roughly doubles bf16-mode gradient error (≈10% vs ≈5% boundary-only
+# on the 8q test config, tests/test_bf16.py) — measured as acceptable for
+# convergence; set QFEDX_MXU_BF16=0 to keep bf16 at the HBM boundary only.
+_MXU_BF16 = False
+
+
+def _mxu_bf16_enabled(slabs_bf16: bool) -> bool:
+    return slabs_bf16 and os.environ.get("QFEDX_MXU_BF16", "1") != "0"
 
 
 # --------------------------------------------------------------------------
@@ -125,8 +136,11 @@ def _lane_perm_cnot(pc: int, pt: int):
 def _matmul_lanes(x, m):
     """(..., 128) @ (128, 128) on the MXU, f32 accumulate."""
     shape = x.shape
+    x = x.reshape(-1, LANES)
+    if _MXU_BF16:
+        x, m = x.astype(jnp.bfloat16), m.astype(jnp.bfloat16)
     out = jax.lax.dot_general(
-        x.reshape(-1, LANES),
+        x,
         m,
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -283,21 +297,89 @@ def _entangle_ring_reverse(x, y, n: int):
     return x, y
 
 
-def _z_signs(n: int, q: int, r: int):
-    """±1 sign array (R, 128) for ⟨Z_q⟩ (broadcasts against per-sample
-    (R, 128) slices; rank 2 — Mosaic's layout inference chokes on
-    singleton-leading reductions, so per-sample work stays rank 2)."""
-    if q <= n - LANE_QUBITS - 1:
-        bit = (
-            jax.lax.broadcasted_iota(jnp.int32, (r, LANES), 0)
-            >> _row_bitpos(n, q)
-        ) & 1
-    else:
-        bit = (
-            jax.lax.broadcasted_iota(jnp.int32, (r, LANES), 1)
-            >> _lane_bitpos(n, q)
-        ) & 1
-    return (1 - 2 * bit).astype(jnp.float32)
+# --------------------------------------------------------------------------
+# Readout / λ-seed sign matrices. ⟨Z_q⟩ signs factorize per qubit into
+# (row sign)·(lane sign) with the other factor ≡ 1, so the whole readout —
+# and the backward's λ = 2·S∘ψ seed — become a couple of small matmuls
+# instead of BB·n unrolled scalar reductions. The Mosaic program then no
+# longer grows with the batch block, which is what makes large BB (and
+# fast compiles) possible at n ≤ 14. Matrices use GLOBAL qubit columns:
+# col q < n−7 ↔ row qubit q, n−7 ≤ q < n ↔ lane qubit q — disjoint, so
+# row and lane contributions simply add.
+# --------------------------------------------------------------------------
+
+
+def _zrow_matrix(n: int, r: int):
+    """(R, 128): [rr, q] = ±1 sign of row-qubit q at row index rr; zero
+    for q ≥ n−7."""
+    i = jax.lax.broadcasted_iota(jnp.int32, (r, LANES), 0)
+    q = jax.lax.broadcasted_iota(jnp.int32, (r, LANES), 1)
+    nrow = n - LANE_QUBITS
+    bit = (i >> jnp.maximum((nrow - 1) - q, 0)) & 1
+    val = (1 - 2 * bit).astype(jnp.float32)
+    return jnp.where(q < nrow, val, 0.0)
+
+
+def _zlane_matrix(n: int):
+    """(128, 128): [l, q] = ±1 sign of lane-qubit q at lane l; zero
+    outside n−7 ≤ q < n."""
+    nrow = n - LANE_QUBITS
+    l = jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 0)
+    q = jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 1)
+    bit = (l >> jnp.clip(n - 1 - q, 0, LANE_QUBITS - 1)) & 1
+    val = (1 - 2 * bit).astype(jnp.float32)
+    return jnp.where((q >= nrow) & (q < n), val, 0.0)
+
+
+def _dot(a, b):
+    return jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _zexp_block(probs, n: int):
+    """⟨Z_q⟩ for all qubits of a (BB, R, 128) probability block → a
+    (BB, 128) slab with global qubit columns (cols ≥ n zero). Always
+    f32 (via _dot, never _matmul_lanes): readout must not pick up
+    _MXU_BF16 rounding — the backward's λ seed is f32 and the two must
+    match precision."""
+    bb, r = probs.shape[0], probs.shape[1]
+    lane_sums = jnp.sum(probs, axis=2)  # (BB, R)
+    row_z = _dot(lane_sums, _zrow_matrix(n, r))  # (BB, 128)
+    lane_part = _dot(probs.reshape(-1, LANES), _zlane_matrix(n))
+    lane_z = jnp.sum(lane_part.reshape(bb, r, LANES), axis=1)
+    return row_z + lane_z
+
+
+def _zrow_matrix_t(n: int, r: int):
+    """(128, R) transpose of _zrow_matrix, built directly (Mosaic's
+    matmul dislikes transposed dot_general operand forms)."""
+    q = jax.lax.broadcasted_iota(jnp.int32, (LANES, r), 0)
+    i = jax.lax.broadcasted_iota(jnp.int32, (LANES, r), 1)
+    nrow = n - LANE_QUBITS
+    bit = (i >> jnp.maximum((nrow - 1) - q, 0)) & 1
+    val = (1 - 2 * bit).astype(jnp.float32)
+    return jnp.where(q < nrow, val, 0.0)
+
+
+def _zlane_matrix_t(n: int):
+    """(128, 128) transpose of _zlane_matrix, built directly."""
+    nrow = n - LANE_QUBITS
+    q = jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 0)
+    l = jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 1)
+    bit = (l >> jnp.clip(n - 1 - q, 0, LANE_QUBITS - 1)) & 1
+    val = (1 - 2 * bit).astype(jnp.float32)
+    return jnp.where((q >= nrow) & (q < n), val, 0.0)
+
+
+def _lambda_seed(ctb, n: int, r: int):
+    """S(b, rr, l) = Σ_q ct[b,q]·sign_q(rr,l) from a (BB, 128) cotangent
+    block (global qubit cols) — the diagonal of the λ = 2·S∘ψ seed, as
+    two matmuls + a broadcast add (row and lane sign factors are ≡ 1 on
+    the other index)."""
+    s_row = _dot(ctb, _zrow_matrix_t(n, r))  # (BB, R)
+    s_lane = _dot(ctb, _zlane_matrix_t(n))  # (BB, 128)
+    return s_row[:, :, None] + s_lane[:, None, :]
 
 
 # --------------------------------------------------------------------------
@@ -307,7 +389,12 @@ def _z_signs(n: int, q: int, r: int):
 
 def _fwd_kernel(n: int, n_layers: int, save_state: bool,
                 rx_ref, rz_ref, enc_ref, zexp_ref, xf_ref=None, yf_ref=None):
-    x = enc_ref[...]
+    # Slabs may arrive bf16 (QFEDX_DTYPE=bf16 — HBM traffic halves);
+    # in-kernel arithmetic is always f32: the state never leaves VMEM, so
+    # upcasting costs no bandwidth, and the long gate chain keeps f32
+    # accuracy. Only the HBM boundary (enc in, xf/yf residuals out) is low
+    # precision.
+    x = enc_ref[...].astype(jnp.float32)
     y = jnp.zeros_like(x)
 
     # The layer loop is a lax.fori_loop with the layer index traced (SMEM
@@ -323,19 +410,16 @@ def _fwd_kernel(n: int, n_layers: int, save_state: bool,
 
     x, y = jax.lax.fori_loop(0, n_layers, layer, (x, y))
     probs = x * x + y * y
-    bb, r = x.shape[0], x.shape[1]
-    # zexp lives in SMEM and is written as per-(sample, qubit) scalar
-    # stores from full reductions of rank-2 per-sample slices: vector
-    # writes of tiny (bb, n) blocks violate TPU block-divisibility rules,
-    # and singleton-batch vector reductions hit Mosaic relayout bugs.
-    row0 = pl.program_id(0) * bb
-    for b in range(bb):
-        pb = probs[b]
-        for q in range(n):
-            zexp_ref[row0 + b, q] = jnp.sum(pb * _z_signs(n, q, r))
+    # Readout as two matmuls into a (1, BB, 128) VMEM slab (global qubit
+    # columns; leading singleton = grid step, which keeps the block's last
+    # two dims equal to the array's — TPU block-divisibility) — replaces
+    # the BB·n unrolled scalar SMEM stores this kernel used in round 2,
+    # whose program size grew with the batch block and capped both BB and
+    # compile speed at n ≤ 14.
+    zexp_ref[...] = _zexp_block(probs, n)[None]
     if save_state:
-        xf_ref[...] = x
-        yf_ref[...] = y
+        xf_ref[...] = x.astype(xf_ref.dtype)
+        yf_ref[...] = y.astype(yf_ref.dtype)
 
 
 # --------------------------------------------------------------------------
@@ -398,23 +482,18 @@ def _contract_w(d_entries, wrr, wri):
 
 
 def _bwd_kernel(n: int, n_layers: int,
-                rx_ref, rz_ref, xf_ref, yf_ref, ct_ref, drx_ref, drz_ref):
-    x = xf_ref[...]
-    y = yf_ref[...]
-    bb, r = x.shape[0], x.shape[1]
+                rx_ref, rz_ref, xf_ref, yf_ref, ct_ref,
+                drx_ref, drz_ref, dencx_ref):
+    x = xf_ref[...].astype(jnp.float32)  # bf16 residuals upcast on load
+    y = yf_ref[...].astype(jnp.float32)
+    r = x.shape[1]
 
     # λ = ∂(Σ_k ct_k ⟨Z_k⟩)/∂ψ = 2·S∘ψ with S = Σ_k ct_k σ_k (diagonal).
-    # ct is SMEM; S is built per sample from scalar ct reads × rank-2
-    # sign patterns (same Mosaic singleton-layout avoidance as the
-    # forward's zexp), then stacked along the leading sample dim.
-    row0 = pl.program_id(0) * bb
-    per_sample = []
-    for b in range(bb):
-        sb = ct_ref[row0 + b, 0] * _z_signs(n, 0, r)
-        for q in range(1, n):
-            sb = sb + ct_ref[row0 + b, q] * _z_signs(n, q, r)
-        per_sample.append(sb)
-    s = jnp.stack(per_sample, axis=0)
+    # ct arrives as a (1, BB, 128) VMEM block (global qubit columns; the
+    # leading singleton is the grid step — block-divisibility); S is two
+    # matmuls + broadcast add (see _lambda_seed) — no per-sample unrolled
+    # loops (round-3 restructure, matching the forward).
+    s = _lambda_seed(ct_ref[...][0], n, r)
     lx, ly = 2.0 * s * x, 2.0 * s * y
 
     # Gradient outputs live in SMEM and are written as scalar stores —
@@ -448,7 +527,12 @@ def _bwd_kernel(n: int, n_layers: int,
             lx, ly = _apply_rot(lx, ly, n, q, ur, ui)  # λ ← U†λ
         return x, y, lx, ly
 
-    jax.lax.fori_loop(0, n_layers, layer_bwd, (x, y, lx, ly))
+    x, y, lx, ly = jax.lax.fori_loop(0, n_layers, layer_bwd, (x, y, lx, ly))
+    # After the full reverse sweep λ sits at the circuit input: it IS the
+    # cotangent of the (real) encoded state — the enc VJP comes for free
+    # from the same single pass (λ's imaginary slab is the cotangent of
+    # the input's imaginary part, which the real enc does not have).
+    dencx_ref[...] = lx.astype(dencx_ref.dtype)
 
 
 # --------------------------------------------------------------------------
@@ -468,13 +552,14 @@ def _compiler_params():
 
 
 def _block_batch(n: int, batch: int, heavy: bool = False) -> int:
-    """Samples per grid step, sized to the ~16MB scoped VMEM: the live set
+    """Samples per grid step, sized to the raised 100MB scoped-VMEM budget
+    the wrapper requests (_VMEM_LIMIT; v5e has 128MB VMEM): the live set
     is the (re, im) state slabs plus Mosaic's stack of unrolled-gate
     temporaries. ``heavy`` covers the residual-saving forward and the
     adjoint backward (extra xf/yf outputs resp. λ slabs — measured on
-    v5e: the light budget OOMed the heavy variants at n=14 by ~5%).
-    Never larger than the (power-of-two-rounded) real batch, so small
-    batches aren't zero-padded up to the VMEM budget."""
+    v5e against the 100MB budget: the light block size OOMed the heavy
+    variants at n=14 by ~5%). Never larger than the (power-of-two-rounded)
+    real batch, so small batches aren't zero-padded up to the budget."""
     bb = int(os.environ.get("QFEDX_FUSED_BB", "0"))
     if bb <= 0:
         bb = max(1, 1 << max(0, (16 if heavy else 17) - n))
@@ -492,10 +577,10 @@ def hea_zexp(rx: jnp.ndarray, rz: jnp.ndarray, enc: jnp.ndarray,
     rx, rz: (L, n) rotation angles. enc: (B, 2^n) REAL encoded state
     (angle encoding yields a real product state). Returns (B, n).
 
-    Differentiable in (rx, rz) via the fused adjoint backward; ``enc`` is
-    treated as data (its cotangent is zero) — callers must not route
-    trainable parameters through it (models.vqc only uses this path for
-    the plain angle encoder, where enc depends on inputs only).
+    Differentiable in (rx, rz) via the fused adjoint backward, AND in
+    ``enc``: the reverse sweep ends with the cotangent λ at the circuit
+    input, which is exactly dL/d(enc) (real part — enc is real), so
+    grad-wrt-inputs agrees with the per-gate XLA path.
     """
     # Undifferentiated primal (evaluation): forward-only kernel, no
     # final-state residuals written to HBM. The VJP forward (_hea_fwd)
@@ -515,6 +600,7 @@ def _pad_batch(enc: jnp.ndarray, bb: int) -> jnp.ndarray:
 
 
 def _fwd_call(rx, rz, enc, n_qubits: int, n_layers: int, save_state: bool):
+    global _MXU_BF16
     n, el = n_qubits, n_layers
     b = enc.shape[0]
     r = 1 << (n - LANE_QUBITS)
@@ -525,21 +611,26 @@ def _fwd_call(rx, rz, enc, n_qubits: int, n_layers: int, save_state: bool):
     kernel = functools.partial(_fwd_kernel, n, el, save_state)
     smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
     slab = lambda: pl.BlockSpec((bb, r, LANES), lambda i: (i, 0, 0))
-    zshape = jax.ShapeDtypeStruct((bp, n), jnp.float32)
-    sshape = jax.ShapeDtypeStruct((bp, r, LANES), jnp.float32)
-    # zexp is an SMEM output written as scalar stores (see _fwd_kernel).
-    out_specs = [smem()] + ([slab(), slab()] if save_state else [])
+    zspec = pl.BlockSpec((1, bb, LANES), lambda i: (i, 0, 0))
+    zshape = jax.ShapeDtypeStruct((bp // bb, bb, LANES), jnp.float32)
+    sshape = jax.ShapeDtypeStruct((bp, r, LANES), enc.dtype)
+    # zexp is a (grid, BB, 128) VMEM slab with global qubit columns.
+    out_specs = [zspec] + ([slab(), slab()] if save_state else [])
     out_shape = [zshape] + ([sshape, sshape] if save_state else [])
-    outs = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[smem(), smem(), slab()],
-        out_specs=out_specs,
-        out_shape=out_shape,
-        compiler_params=_compiler_params(),
-        interpret=_INTERPRET,
-    )(rx, rz, encp)
-    return (outs[0][:b],) + tuple(outs[1:])
+    prev, _MXU_BF16 = _MXU_BF16, _mxu_bf16_enabled(enc.dtype == jnp.bfloat16)
+    try:
+        outs = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[smem(), smem(), slab()],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            compiler_params=_compiler_params(),
+            interpret=_INTERPRET,
+        )(rx, rz, encp)
+    finally:
+        _MXU_BF16 = prev
+    return (outs[0].reshape(bp, LANES)[:b, :n],) + tuple(outs[1:])
 
 
 def _hea_fwd(rx, rz, enc, n_qubits, n_layers):
@@ -548,31 +639,42 @@ def _hea_fwd(rx, rz, enc, n_qubits, n_layers):
 
 
 def _hea_bwd(n_qubits, n_layers, res, ct):
+    global _MXU_BF16
     rx, rz, xf, yf = res
     n, el = n_qubits, n_layers
     r = 1 << (n - LANE_QUBITS)
     bp = xf.shape[0]
     bb = _block_batch(n, bp, heavy=True)
     ctp = _pad_batch(ct, bb)  # zero cotangent for padded samples
+    # ct as a (grid, BB, 128) VMEM array with global qubit columns (cols
+    # ≥ n zero) — the _lambda_seed matmul form needs a full-lane slab.
+    ctp = jnp.concatenate(
+        [ctp, jnp.zeros((bp, LANES - ctp.shape[1]), ctp.dtype)], axis=1
+    ).reshape(bp // bb, bb, LANES)
     grid = (bp // bb,)
     kernel = functools.partial(_bwd_kernel, n, el)
     smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
     slab = lambda: pl.BlockSpec((bb, r, LANES), lambda i: (i, 0, 0))
+    ctspec = pl.BlockSpec((1, bb, LANES), lambda i: (i, 0, 0))
     acc = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
-    drx, drz = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[smem(), smem(), slab(), slab(), smem()],
-        out_specs=[acc(), acc()],
-        out_shape=[
-            jax.ShapeDtypeStruct((el, n), jnp.float32),
-            jax.ShapeDtypeStruct((el, n), jnp.float32),
-        ],
-        compiler_params=_compiler_params(),
-        interpret=_INTERPRET,
-    )(rx, rz, xf, yf, ctp)
-    # enc is data, not parameters (documented in hea_zexp): zero cotangent.
-    denc = jnp.zeros((ct.shape[0], 1 << n), jnp.float32)
+    prev, _MXU_BF16 = _MXU_BF16, _mxu_bf16_enabled(xf.dtype == jnp.bfloat16)
+    try:
+        drx, drz, dencx = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[smem(), smem(), slab(), slab(), ctspec],
+            out_specs=[acc(), acc(), slab()],
+            out_shape=[
+                jax.ShapeDtypeStruct((el, n), jnp.float32),
+                jax.ShapeDtypeStruct((el, n), jnp.float32),
+                jax.ShapeDtypeStruct((bp, r, LANES), xf.dtype),
+            ],
+            compiler_params=_compiler_params(),
+            interpret=_INTERPRET,
+        )(rx, rz, xf, yf, ctp)
+    finally:
+        _MXU_BF16 = prev
+    denc = dencx.reshape(bp, 1 << n)[: ct.shape[0]]
     return drx, drz, denc
 
 

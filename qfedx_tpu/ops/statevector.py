@@ -25,12 +25,12 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from qfedx_tpu.ops.cpx import CArray, RDTYPE, cabs2, vdot
+from qfedx_tpu.ops.cpx import CArray, cabs2, state_dtype, vdot
 
 
 def zero_state(n_qubits: int) -> CArray:
     """|0...0⟩ as a (2,)*n CArray (real)."""
-    re = jnp.zeros((2,) * n_qubits, dtype=RDTYPE)
+    re = jnp.zeros((2,) * n_qubits, dtype=state_dtype())
     re = re.reshape(-1).at[0].set(1.0).reshape((2,) * n_qubits)
     return CArray(re, None)
 
@@ -67,7 +67,17 @@ def _contract_move(g: jnp.ndarray, s: jnp.ndarray, axes, src, dst) -> jnp.ndarra
 
 
 def _apply(gate: CArray, state: CArray, axes, src, dst) -> CArray:
-    """out = G·ψ with the four real-contraction cases resolved at trace time."""
+    """out = G·ψ with the four real-contraction cases resolved at trace time.
+
+    Gates are built in f32 from f32 angles and cast here to the state's
+    dtype (bf16 under QFEDX_DTYPE=bf16) so mixed-dtype promotion never
+    silently upcasts the state; parameter gradients flow back through the
+    cast to f32."""
+    if gate.re.dtype != state.re.dtype:
+        gate = CArray(
+            gate.re.astype(state.re.dtype),
+            None if gate.im is None else gate.im.astype(state.re.dtype),
+        )
     rr = _contract_move(gate.re, state.re, axes, src, dst)
     if gate.im is None and state.im is None:
         return CArray(rr, None)
@@ -83,20 +93,7 @@ def _apply(gate: CArray, state: CArray, axes, src, dst) -> CArray:
 
 
 def apply_gate(state: CArray, gate: CArray, qubit: int) -> CArray:
-    """Apply a (2,2) gate to axis ``qubit`` of a (2,)*n state.
-
-    With QFEDX_PALLAS=1 on TPU, large complex states (≥2^14 amplitudes)
-    stream through the fused Pallas kernel (ops.pallas_gates) instead;
-    known-real cases keep the trace-time cross-term elision below, which
-    the general kernel can't match.
-    """
-    if state.ndim >= 14 and state.im is not None and gate.ndim == 2:
-        from qfedx_tpu.ops import pallas_gates
-
-        if pallas_gates.pallas_enabled() and pallas_gates.pallas_eligible(
-            state.ndim, qubit
-        ):
-            return pallas_gates.apply_gate_pallas(state, gate, qubit)
+    """Apply a (2,2) gate to axis ``qubit`` of a (2,)*n state."""
     return _apply(gate, state, ((1,), (qubit,)), 0, qubit)
 
 
@@ -106,31 +103,34 @@ def apply_gate_2q(state: CArray, gate: CArray, q1: int, q2: int) -> CArray:
 
 
 def probabilities(state: CArray) -> jnp.ndarray:
-    """|ψ|² flattened to (2^n,) in big-endian qubit order."""
-    return cabs2(state).reshape(-1)
+    """|ψ|² flattened to (2^n,) in big-endian qubit order (f32 — sampling
+    and noise maps downstream need full precision regardless of the
+    state dtype)."""
+    return cabs2(state).reshape(-1).astype(jnp.float32)
 
 
 def expect_z(state: CArray, qubit: int) -> jnp.ndarray:
-    """⟨Z_qubit⟩ = P(qubit=0) − P(qubit=1), real scalar.
+    """⟨Z_qubit⟩ = P(qubit=0) − P(qubit=1), real f32 scalar.
 
     The readout primitive: reference ROADMAP.md:128 maps ⟨Z⟩ → logit.
+    Accumulates in f32 (bf16 state support — see cpx.state_dtype).
     """
     probs = cabs2(state)
     n = probs.ndim
     z = jnp.array([1.0, -1.0], dtype=probs.dtype).reshape(
         (1,) * qubit + (2,) + (1,) * (n - qubit - 1)
     )
-    return jnp.sum(probs * z)
+    return jnp.sum(probs * z, dtype=jnp.float32)
 
 
 def expect_z_all(state: CArray) -> jnp.ndarray:
-    """⟨Z_k⟩ for every qubit k at once, shape (n,)."""
+    """⟨Z_k⟩ for every qubit k at once, shape (n,), f32-accumulated."""
     probs = cabs2(state)
     n = probs.ndim
     out = []
     for k in range(n):
         axes = tuple(i for i in range(n) if i != k)
-        marg = jnp.sum(probs, axis=axes)
+        marg = jnp.sum(probs, axis=axes, dtype=jnp.float32)
         out.append(marg[0] - marg[1])
     return jnp.stack(out)
 

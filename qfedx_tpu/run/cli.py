@@ -83,6 +83,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--dp-clip", type=float, default=None,
                    help="enable DP with this L2 clip norm")
     t.add_argument("--dp-sigma", type=float, default=1.0)
+    t.add_argument("--dp-mode", default="client", choices=["client", "example"],
+                   help="client = DP-FedAvg (clip+noise each client update, "
+                        "1 accountant step/round); example = DP-SGD "
+                        "(per-example clipping inside local steps, "
+                        "accountant composes per local step)")
     t.add_argument("--secure-agg", action="store_true")
     t.add_argument("--secure-agg-mode", default="ring", choices=["ring", "pairwise"],
                    help="pair graph: k-successor ring (O(k)/client) or complete (O(C)/client)")
@@ -90,14 +95,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ring hops k; unmasking a client needs its 2k neighbors to collude")
     # run
     t.add_argument("--eval-every", type=int, default=1)
-    t.add_argument("--rounds-per-call", type=int, default=1,
+    t.add_argument("--rounds-per-call", type=int, default=None,
                    help="scan this many rounds inside one device dispatch "
-                        "(bit-identical; amortizes host\u2194device latency — "
-                        "Clamped to min(--eval-every, --checkpoint-every) - "
-                        "raise those cadences to scan deeper")
+                        "(bit-identical; amortizes host-device latency). "
+                        "Evaluation rides INSIDE the scanned program "
+                        "(per-round on-device accuracy, no --eval-every "
+                        "trade-off) for host-callable models; only "
+                        "--checkpoint-every still bounds a chunk. Default "
+                        "10 (1 for --sv-size > 1, whose eval is host-side "
+                        "and still paces chunks via --eval-every)")
     t.add_argument("--eval-batches", type=int, default=None,
                    help="cap per-round eval at this many 256-sample batches")
-    t.add_argument("--checkpoint-every", type=int, default=5)
+    t.add_argument("--checkpoint-every", type=int, default=10)
     t.add_argument("--seed", type=int, default=42)
     t.add_argument("--run-root", default="runs")
     t.add_argument("--name", default=None)
@@ -125,7 +134,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 def config_from_args(a: argparse.Namespace) -> ExperimentConfig:
     dp = (
-        DPConfig(clip_norm=a.dp_clip, noise_multiplier=a.dp_sigma)
+        DPConfig(
+            clip_norm=a.dp_clip, noise_multiplier=a.dp_sigma, mode=a.dp_mode
+        )
         if a.dp_clip is not None
         else None
     )
@@ -170,7 +181,14 @@ def config_from_args(a: argparse.Namespace) -> ExperimentConfig:
         ),
         num_rounds=a.rounds,
         eval_every=a.eval_every,
-        rounds_per_call=a.rounds_per_call,
+        # Default deep scan only where in-scan eval applies; sv-sharded
+        # models evaluate host-side, where a deep default would just
+        # clamp to --eval-every and warn on every plain run.
+        rounds_per_call=(
+            a.rounds_per_call
+            if a.rounds_per_call is not None
+            else (1 if a.sv_size > 1 else 10)
+        ),
         eval_batches=a.eval_batches,
         checkpoint_every=a.checkpoint_every,
         seed=a.seed,

@@ -31,6 +31,12 @@ class DataConfig:
     partition: str = "iid"  # iid | dirichlet
     alpha: float = 0.5  # Dirichlet concentration (ROADMAP.md:106)
     seed: int = 42
+    # Synthetic-fallback sizes (used only when raw files are absent).
+    # Per-example DP-SGD cells need realistic per-client dataset sizes:
+    # the accountant's sampling rate is B/S_min, so a tiny synthetic set
+    # inflates ε regardless of σ.
+    synthetic_train: int = 4096
+    synthetic_test: int = 1024
 
 
 @dataclass(frozen=True)
@@ -69,9 +75,12 @@ class ExperimentConfig:
     eval_every: int = 1
     # Rounds scanned inside one device dispatch (fed.round.make_fed_rounds):
     # bit-identical to sequential rounds, amortizes host↔device latency.
-    rounds_per_call: int = 1
+    # Evaluation runs on-device inside the scan (per-round accuracy at any
+    # depth), so the default scans deep out of the box; checkpoints still
+    # bound a chunk.
+    rounds_per_call: int = 10
     eval_batches: int | None = None  # cap eval cost on large eval sets
-    checkpoint_every: int = 5
+    checkpoint_every: int = 10
     seed: int = 42
     run_root: str = "runs"
     name: str | None = None
@@ -128,6 +137,14 @@ def build_model(cfg: ExperimentConfig, num_classes: int):
     if m.model == "qkernel":
         from qfedx_tpu.models.kernel import make_quantum_kernel_classifier
 
+        if m.depolarizing_p or m.amp_damping_gamma or m.readout_flip or m.shots:
+            # The kernel head evaluates fidelities through a closed form,
+            # not a statevector the channels could act on — silently
+            # training noiselessly under noise flags would misreport runs.
+            raise ValueError(
+                "model='qkernel' has no noise support; noise channels are "
+                "a vqc-engine feature (use --model vqc)"
+            )
         return make_quantum_kernel_classifier(
             m.n_qubits, n_landmarks=m.n_landmarks, num_classes=num_classes
         )
@@ -201,7 +218,10 @@ def build_data(cfg: ExperimentConfig) -> dict[str, Any]:
     else:
         features = "image"
 
-    spec, train_xy, test_xy = load_dataset(d.dataset, d.raw_folder, seed=d.seed)
+    spec, train_xy, test_xy = load_dataset(
+        d.dataset, d.raw_folder, seed=d.seed,
+        synthetic_train=d.synthetic_train, synthetic_test=d.synthetic_test,
+    )
     prep = preprocess(
         train_xy,
         test_xy,

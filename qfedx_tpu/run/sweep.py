@@ -54,15 +54,21 @@ def preset_cells(preset: str) -> list[dict]:
             _cell("q4-dp", qubits=4, clients=4, rounds=4, dp_sigma=1.0, dp_clip=1.0),
         ]
     if preset == "roadmap":
-        # ROADMAP.md:105-107 grid: qubits × α (non-IID skew) × p (sampling).
-        # Every cell runs the SAME binary task (0 vs 1): the 2-qubit cell
-        # can only read out 2 classes (one ⟨Z⟩ logit per qubit), and the
-        # whole grid must share one task for its cells — the width axis,
-        # the α/p columns vs the iid baseline — to be comparable.
+        # ROADMAP.md:105-107 grid: qubits × depth × α (non-IID skew) ×
+        # p (sampling). Every cell runs the SAME binary task (0 vs 1): the
+        # 2-qubit cell can only read out 2 classes (one ⟨Z⟩ logit per
+        # qubit), and the whole grid must share one task for its cells —
+        # the width axis, the α/p columns vs the iid baseline — to be
+        # comparable.
         cells = []
         bi = {"classes": (0, 1)}
         for q in (2, 4, 8):
             cells.append(_cell(f"q{q}-iid", qubits=q, clients=8, **bi))
+        # Depth axis (ROADMAP.md:105: "depth 1–3").
+        for d in (1, 2, 3):
+            cells.append(
+                _cell(f"q4-d{d}", qubits=4, clients=8, layers=d, **bi)
+            )
         for alpha in (0.1, 0.3, 1.0):
             cells.append(
                 _cell(f"q4-a{alpha}", qubits=4, clients=8,
@@ -77,6 +83,18 @@ def preset_cells(preset: str) -> list[dict]:
                 _cell(f"q4-dp{sigma}", qubits=4, clients=8,
                       dp_sigma=sigma, dp_clip=1.0, **bi)
             )
+        # Real-data cells (ROADMAP.md:104 names Iris explicitly): the
+        # bundled Iris table — the sweep's only guaranteed-real dataset in
+        # a zero-egress environment — binary (setosa vs versicolor) and
+        # the full 3-class task on 4 qubits.
+        cells.append(
+            _cell("iris-4q", dataset="iris", qubits=4, clients=4,
+                  rounds=10, **bi)
+        )
+        cells.append(
+            _cell("iris-4q-3c", dataset="iris", qubits=4, clients=4,
+                  rounds=10, classes=(0, 1, 2))
+        )
         # Scaling axis: SAME model/config, ONLY the cohort size varies —
         # the one comparison the speedup-vs-clients plot may draw from.
         for c in (2, 8, 32):
@@ -92,16 +110,31 @@ def preset_cells(preset: str) -> list[dict]:
         # width, which costs O(n) through the product-kernel closed form).
         return [
             _cell("c1-4q-2cli", qubits=4, clients=2, classes=(0, 1)),
-            _cell("c2-8q-dp", qubits=8, clients=10, partition="dirichlet",
-                  alpha=0.5, dp_sigma=1.0, dp_clip=1.0),
-            _cell("c3-cnn-fedprox", model="cnn", clients=32, algorithm="fedprox",
-                  prox_mu=0.01, rounds=4),
+            # Config 2 names DP-SGD: per-example mode, tuned so the cell
+            # demonstrably learns at single-digit ε (binary task — the
+            # round-2 3-class cell sat at chance; the no-DP 8q ceiling on
+            # this harness task is ~0.77, see sweep-roadmap q8-iid).
+            # synthetic_train raised: ε composes at q = B/S_pad, so
+            # realistic per-client dataset sizes are what make single-digit
+            # ε reachable at all.
+            _cell("c2-8q-dpsgd", qubits=8, clients=10, partition="dirichlet",
+                  alpha=1.0, classes=(0, 1), dp_sigma=1.0, dp_clip=1.0,
+                  dp_mode="example", lr=0.2, rounds=16, batch_size=16,
+                  synthetic_train=16384),
+            # Config 3 is CIFAR-10: route the real loader (32×32×3 shape
+            # contract; synthetic fallback keeps that shape when raw CIFAR
+            # files are absent — this environment has no egress).
+            _cell("c3-cnn-fedprox", model="cnn", dataset="cifar10",
+                  clients=32, algorithm="fedprox", prox_mu=0.01, rounds=4),
             _cell("c4-12q-reupload-secagg", qubits=12, clients=64,
                   encoding="reupload", secure_agg=True, rounds=4),
-            _cell("c5-svqc", qubits=8, clients=32, sv_size=4, rounds=6,
-                  classes=(0, 1)),
+            _cell("c5-svqc", qubits=8, clients=32, sv_size=4, rounds=16,
+                  classes=(0, 1), local_epochs=2, lr=0.2),
             _cell("c5-qkernel20", model="qkernel", qubits=20, clients=32,
                   rounds=4),
+            # Real-data column (Iris is bundled — see the roadmap preset).
+            _cell("iris-4q", dataset="iris", qubits=4, clients=4,
+                  rounds=10, classes=(0, 1)),
         ]
     raise ValueError(f"unknown preset {preset!r}")
 
@@ -110,15 +143,21 @@ def _config_from_cell(cell: dict, seed: int) -> ExperimentConfig:
     dp = None
     if cell.get("dp_clip") is not None:
         dp = DPConfig(
-            clip_norm=cell["dp_clip"], noise_multiplier=cell.get("dp_sigma", 1.0)
+            clip_norm=cell["dp_clip"],
+            noise_multiplier=cell.get("dp_sigma", 1.0),
+            mode=cell.get("dp_mode", "client"),
         )
     return ExperimentConfig(
         data=DataConfig(
+            dataset=cell.get("dataset", "mnist"),
             classes=cell.get("classes", (0, 1, 2)),
+            features=cell.get("features", "pca"),
+            n_features=cell.get("n_features"),
             num_clients=cell.get("clients", 4),
             partition=cell.get("partition", "iid"),
             alpha=cell.get("alpha", 0.5),
             seed=seed,
+            synthetic_train=cell.get("synthetic_train", 4096),
         ),
         model=ModelConfig(
             model=cell.get("model", "vqc"),
@@ -164,6 +203,7 @@ def _run_cell(cell: dict, seed: int) -> dict:
         num_rounds=cfg.num_rounds,
         seed=seed,
         eval_every=cfg.eval_every,
+        rounds_per_call=cfg.rounds_per_call,
     )
     wall = time.perf_counter() - t0
     final = res.evaluate(res.params, test_x, test_y)

@@ -114,13 +114,17 @@ def train_federated(
                 n_dev -= 1
             mesh = client_mesh(num_devices=n_dev)
     round_fn = make_fed_round(model, cfg, mesh, num_clients=num_clients)
-    # Clamp the scan length to what the eval/checkpoint cadences allow —
-    # chunks never cross a host-action boundary, so a larger K would
-    # silently never engage. Warn so the user knows the effective value.
+    # Scanned chunks carry their own ON-DEVICE eval (fed.round
+    # make_fed_rounds with_eval) for host-callable models, so eval_every
+    # no longer caps the scan depth — per-round accuracy comes out of the
+    # same dispatch. Only checkpoint boundaries still bound a chunk (the
+    # save is a host action). The sv-sharded path keeps host evaluation
+    # and the old clamp.
     requested_rpc = max(1, int(rounds_per_call))
+    in_scan_eval = requested_rpc > 1 and model.sv_size == 1
     rounds_per_call = min(
         requested_rpc,
-        eval_every,
+        requested_rpc if in_scan_eval else eval_every,
         checkpointer.every if checkpointer is not None else requested_rpc,
     )
     if rounds_per_call < requested_rpc:
@@ -128,10 +132,12 @@ def train_federated(
 
         warnings.warn(
             f"rounds_per_call clamped {requested_rpc} → {rounds_per_call}: "
-            "scanned chunks cannot cross eval/checkpoint boundaries "
-            f"(eval_every={eval_every}"
+            "scanned chunks cannot cross "
+            + ("checkpoint" if in_scan_eval else "eval/checkpoint")
+            + " boundaries ("
+            + (f"eval_every={eval_every}, " if not in_scan_eval else "")
             + (
-                f", checkpoint_every={checkpointer.every}"
+                f"checkpoint_every={checkpointer.every}"
                 if checkpointer is not None
                 else ""
             )
@@ -139,14 +145,18 @@ def train_federated(
             UserWarning,
             stacklevel=2,
         )
-    chunk_fn = (
-        make_fed_rounds(
-            model, cfg, mesh, num_clients=num_clients,
-            rounds_per_call=rounds_per_call,
-        )
-        if rounds_per_call > 1
-        else None
-    )
+    # Scanned-chunk programs, one per distinct chunk length (the tail of a
+    # run or a checkpoint boundary can shorten a chunk; each length is its
+    # own XLA program — at most two distinct lengths occur per run).
+    _chunk_fns: dict[int, Callable] = {}
+
+    def get_chunk_fn(k: int) -> Callable:
+        if k not in _chunk_fns:
+            _chunk_fns[k] = make_fed_rounds(
+                model, cfg, mesh, num_clients=num_clients,
+                rounds_per_call=k, with_eval=in_scan_eval,
+            )
+        return _chunk_fns[k]
     # Two evaluators: the capped one paces per-round eval (eval_batches
     # bounds its cost); the uncapped one is exposed on TrainResult so final
     # reported metrics always cover the full eval set.
@@ -177,15 +187,54 @@ def train_federated(
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     params = jax.device_put(params, NamedSharding(mesh, P()))
+    ex_dev = ey_dev = None
+    if rounds_per_call > 1 and in_scan_eval:
+        # Device-resident eval set for the scanned in-program eval;
+        # eval_batches caps its size like the capped host evaluator
+        # (256-sample batches). Unlike the host evaluator the in-scan
+        # eval is ONE un-batched apply, so with no explicit cap it is
+        # bounded at 2048 samples — a 10k-sample test set through a wide
+        # dense VQC in a single vmapped forward would materialize
+        # multi-GB statevector slabs per round. Final reported metrics
+        # still go through the UNCAPPED (batched) evaluator below.
+        cap = (
+            min(len(test_x), 2048)
+            if eval_batches is None
+            else min(len(test_x), eval_batches * 256)
+        )
+        repl = NamedSharding(mesh, P())
+        ex_dev = jax.device_put(
+            np.asarray(test_x[:cap], dtype=np.float32), repl
+        )
+        ey_dev = jax.device_put(np.asarray(test_y[:cap], dtype=np.int32), repl)
 
     accountant = RDPAccountant() if cfg.dp is not None else None
+    # Composition granularity per ROUND: client-level DP-FedAvg is one
+    # mechanism invocation per round at q = client_fraction; per-example
+    # DP-SGD composes one invocation per LOCAL step (epochs × batches) at
+    # q = B / S_pad — each epoch permutes the client's S_pad slots into
+    # S_pad/B batches, so any given example lands in a given step with
+    # probability exactly B/S_pad, uniformly across (heterogeneous,
+    # padded) clients; the Poisson-subsampled RDP bound at that q is the
+    # standard DP-SGD accounting for shuffled samplers (Abadi et al.
+    # q = L/N; what Opacus/TF-privacy do). client_fraction is NOT folded
+    # into q: all of a round's local steps share one participation draw,
+    # so claiming independent per-step amplification from it would
+    # underreport ε — client sampling is treated conservatively as
+    # amplification-free in example mode.
+    if accountant is not None and cfg.dp.mode == "example":
+        acct_q = min(1.0, cfg.batch_size / cx.shape[1])
+        acct_steps = cfg.local_epochs * (cx.shape[1] // cfg.batch_size)
+    else:
+        acct_q = cfg.client_fraction
+        acct_steps = 1
     if accountant is not None and start_round > 0:
         # Resume must account for the privacy already spent by the rounds
         # the checkpoint covers, or ε is underreported after restarts.
         accountant.step(
-            q=cfg.client_fraction,
+            q=acct_q,
             sigma=cfg.dp.noise_multiplier,
-            num_steps=start_round,
+            num_steps=start_round * acct_steps,
         )
     n_params = trees.tree_size(params)
     # Per round: each participating client uploads Δθ and downloads θ
@@ -209,8 +258,12 @@ def train_federated(
     rnd = start_round
     while rnd < num_rounds:
         # Chunk length: never cross an eval or checkpoint boundary (host
-        # actions happen between dispatches), never past the end.
-        until_eval = eval_every - (rnd % eval_every)
+        # actions happen between dispatches), never past the end. With
+        # in-scan eval the accuracy comes out of the dispatch itself, so
+        # eval_every does not bound the chunk.
+        until_eval = (
+            num_rounds if in_scan_eval else eval_every - (rnd % eval_every)
+        )
         until_ckpt = (
             checkpointer.every - (rnd % checkpointer.every)
             if checkpointer is not None
@@ -219,11 +272,20 @@ def train_federated(
         chunk = min(rounds_per_call, until_eval, until_ckpt, num_rounds - rnd)
 
         t0 = time.perf_counter()
-        if chunk == rounds_per_call and chunk_fn is not None:
-            params, stats = chunk_fn(
-                params, scx, scy, scm, round_key_base, rnd
-            )
-            jax.block_until_ready(params)
+        scan_accs = None
+        if chunk > 1 and rounds_per_call > 1:
+            chunk_fn = get_chunk_fn(chunk)
+            if in_scan_eval:
+                params, (stats, accs) = chunk_fn(
+                    params, scx, scy, scm, round_key_base, rnd, ex_dev, ey_dev
+                )
+                jax.block_until_ready(params)
+                scan_accs = [float(a) for a in np.asarray(accs)]
+            else:
+                params, stats = chunk_fn(
+                    params, scx, scy, scm, round_key_base, rnd
+                )
+                jax.block_until_ready(params)
             losses = [float(l) for l in np.asarray(stats.mean_loss)]
         else:
             losses = []
@@ -241,16 +303,29 @@ def train_federated(
             metrics = {
                 "round": r + 1,
                 "loss": losses[i],
+                # With chunk > 1, time_s is the chunk-average (the scanned
+                # dispatch has no per-round boundary to time); chunk_rounds
+                # says how many rounds that average amortizes over, so
+                # series from different rounds_per_call stay comparable.
                 "time_s": dt_per_round,
+                "chunk_rounds": chunk,
             }
             if accountant is not None:
                 accountant.step(
-                    q=cfg.client_fraction, sigma=cfg.dp.noise_multiplier
+                    q=acct_q,
+                    sigma=cfg.dp.noise_multiplier,
+                    num_steps=acct_steps,
                 )
                 eps = accountant.epsilon(cfg.dp.delta)
                 result.epsilons.append(eps)
                 metrics["epsilon"] = eps
-            if (r + 1) % eval_every == 0 or r == num_rounds - 1:
+            if scan_accs is not None:
+                # On-device eval came with the scanned dispatch: per-round
+                # accuracy at every round, no host round-trip, no
+                # eval_every trade-off.
+                result.accuracies.append(scan_accs[i])
+                metrics["accuracy"] = scan_accs[i]
+            elif (r + 1) % eval_every == 0 or r == num_rounds - 1:
                 eval_metrics = evaluate(params, test_x, test_y)
                 result.accuracies.append(eval_metrics["accuracy"])
                 metrics.update(eval_metrics)

@@ -1,0 +1,178 @@
+"""bf16 statevector path (QFEDX_DTYPE=bf16) vs the f32 default.
+
+The dense regime is HBM-bound at ~1 FLOP/byte (BENCH_r02: ~60% HBM util),
+so halving state bytes is the dominant remaining lever. The recipe is
+bf16-state / f32-accumulate (cpx.state_dtype): states and gate application
+carry bf16, parameters and every reduction/readout stay f32. These tests
+quantify the numerical cost (forward + gradient error vs the f32 oracle)
+and pin convergence parity on the flagship config.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import qfedx_tpu.ops.fused_hea as fh
+from qfedx_tpu.circuits.ansatz import hardware_efficient, init_ansatz_params
+from qfedx_tpu.circuits.encoders import angle_encode
+from qfedx_tpu.ops.statevector import expect_z_all
+
+
+@pytest.fixture
+def bf16_env(monkeypatch):
+    monkeypatch.setenv("QFEDX_DTYPE", "bf16")
+    yield
+    monkeypatch.delenv("QFEDX_DTYPE", raising=False)
+
+
+def _zexp(rx, rz, x):
+    def one(xi):
+        state = hardware_efficient(angle_encode(xi), {"rx": rx, "rz": rz})
+        return expect_z_all(state)
+
+    return jax.vmap(one)(x)
+
+
+def _setup(n=8, layers=3, batch=6, seed=0):
+    params = init_ansatz_params(jax.random.PRNGKey(seed), n, layers, scale=0.7)
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.uniform(0, 1, (batch, n)), dtype=jnp.float32)
+    return params["rx"], params["rz"], x
+
+
+def test_state_dtype_env(monkeypatch):
+    from qfedx_tpu.ops.cpx import state_dtype
+
+    monkeypatch.delenv("QFEDX_DTYPE", raising=False)
+    assert state_dtype() == jnp.float32
+    monkeypatch.setenv("QFEDX_DTYPE", "bf16")
+    assert state_dtype() == jnp.bfloat16
+    monkeypatch.setenv("QFEDX_DTYPE", "bfloat16")
+    assert state_dtype() == jnp.bfloat16
+
+
+def test_dense_forward_error_bounded(bf16_env):
+    """⟨Z⟩ under bf16 states stays within ~1e-2 of the f32 value — readout
+    is f32-accumulated, so the error is per-gate rounding, not the sum."""
+    rx, rz, x = _setup()
+    got = _zexp(rx, rz, x)
+    assert got.dtype == jnp.float32  # reductions report f32
+    import os
+
+    os.environ.pop("QFEDX_DTYPE")
+    want = _zexp(rx, rz, x)
+    os.environ["QFEDX_DTYPE"] = "bf16"
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-2)
+
+
+def test_dense_gradient_error_bounded(bf16_env):
+    """Parameter gradients through the bf16 simulation stay close to f32:
+    measured 3–5% relative error on this config (8q, 3 layers) — bounded
+    here at 8%; the convergence-parity test below shows it is benign."""
+    rx, rz, x = _setup(seed=1)
+    w = jnp.asarray(
+        np.random.default_rng(2).normal(size=(x.shape[0], x.shape[1])),
+        dtype=jnp.float32,
+    )
+
+    def loss(rx_, rz_):
+        return jnp.sum(w * _zexp(rx_, rz_, x))
+
+    g_bf = jax.grad(loss, argnums=(0, 1))(rx, rz)
+    import os
+
+    os.environ.pop("QFEDX_DTYPE")
+    g_f32 = jax.grad(loss, argnums=(0, 1))(rx, rz)
+    os.environ["QFEDX_DTYPE"] = "bf16"
+    for gb, gf in zip(g_bf, g_f32):
+        gb, gf = np.asarray(gb, np.float64), np.asarray(gf, np.float64)
+        denom = np.linalg.norm(gf)
+        assert denom > 1e-3  # oracle gradient is nonzero
+        assert np.linalg.norm(gb - gf) / denom < 0.08
+
+
+def test_fused_kernel_bf16_matches_f32(bf16_env):
+    """Fused kernel with bf16 HBM slabs (enc in, residuals out; f32 inside
+    VMEM) reproduces the f32 forward and gradients within bf16 rounding."""
+    old = fh._INTERPRET
+    fh._INTERPRET = True
+    try:
+        n, layers, batch = 8, 2, 4
+        rx, rz, x = _setup(n=n, layers=layers, batch=batch, seed=3)
+        # Random readout weights: an unweighted sum leaves one leaf with a
+        # near-zero f32 gradient, which turns bf16 rounding into a huge
+        # *relative* error on a meaningless denominator.
+        w = jnp.asarray(
+            np.random.default_rng(7).normal(size=(batch, n)), jnp.float32
+        )
+        enc = jax.vmap(lambda xi: angle_encode(xi).re.reshape(-1))(x)
+        assert enc.dtype == jnp.bfloat16
+
+        def loss(rx_, rz_):
+            return jnp.sum(w * fh.hea_zexp(rx_, rz_, enc, n, layers))
+
+        got = fh.hea_zexp(rx, rz, enc, n, layers)
+        g_bf = jax.grad(loss, argnums=(0, 1))(rx, rz)
+
+        import os
+
+        os.environ.pop("QFEDX_DTYPE")
+        enc32 = jax.vmap(lambda xi: angle_encode(xi).re.reshape(-1))(x)
+        assert enc32.dtype == jnp.float32
+
+        def loss32(rx_, rz_):
+            return jnp.sum(w * fh.hea_zexp(rx_, rz_, enc32, n, layers))
+
+        want = fh.hea_zexp(rx, rz, enc32, n, layers)
+        g_f32 = jax.grad(loss32, argnums=(0, 1))(rx, rz)
+        os.environ["QFEDX_DTYPE"] = "bf16"
+
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-2)
+        # ~10% measured with in-kernel bf16 MXU matmuls (each lane gate
+        # re-rounds the state; see fused_hea._MXU_BF16) — bounded at 12%;
+        # convergence parity below is the functional gate.
+        for gb, gf in zip(g_bf, g_f32):
+            gb, gf = np.asarray(gb, np.float64), np.asarray(gf, np.float64)
+            assert np.linalg.norm(gb - gf) / max(np.linalg.norm(gf), 1e-9) < 0.12
+    finally:
+        fh._INTERPRET = old
+
+
+def test_convergence_parity_bf16(bf16_env):
+    """End-to-end federated training of the flagship 8-qubit config: the
+    bf16 run must land in the same accuracy band as the f32 run of the
+    SAME config/seed (round-3 'done' bar — the 3–5% gradient error above
+    must not cost convergence)."""
+    import os
+
+    from qfedx_tpu.data.datasets import load_dataset
+    from qfedx_tpu.data.partition import iid_partition, pack_clients
+    from qfedx_tpu.data.pipeline import preprocess
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+    from qfedx_tpu.run.trainer import train_federated
+
+    _, tr, te = load_dataset(
+        "mnist", synthetic_train=768, synthetic_test=192, seed=1
+    )
+    pre = preprocess(tr, te, classes=(0, 1), features="pca", n_features=8)
+    parts = iid_partition(len(pre.train[0]), 4, seed=0)
+    cx, cy, cmask = pack_clients(*pre.train, parts, pad_multiple=32)
+    model = make_vqc_classifier(n_qubits=8, n_layers=2, num_classes=2)
+    cfg = FedConfig(
+        local_epochs=2, batch_size=32, learning_rate=0.1, optimizer="adam"
+    )
+
+    def run():
+        return train_federated(
+            model, cfg, cx, cy, cmask, *pre.test, num_rounds=8, seed=0,
+            eval_every=8,
+        ).final_accuracy
+
+    acc_bf16 = run()
+    os.environ.pop("QFEDX_DTYPE")
+    acc_f32 = run()
+    os.environ["QFEDX_DTYPE"] = "bf16"
+    assert acc_bf16 > 0.7  # the config demonstrably learns under bf16
+    assert acc_bf16 >= acc_f32 - 0.12  # and tracks the f32 run

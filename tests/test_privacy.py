@@ -91,3 +91,143 @@ def test_accountant_rejects_bad_delta():
 def test_sigma_zero_is_infinite():
     rdp = rdp_subsampled_gaussian(0.5, 0.0, np.array([2, 3]))
     assert np.all(np.isinf(rdp))
+
+
+# --- per-example DP-SGD (BASELINE config 2; ROADMAP.md:50-58) ---------------
+
+
+def _linear_model(n_features=4, num_classes=2):
+    """Tiny linear classifier with hand-computable per-example gradients."""
+    from qfedx_tpu.models.api import Model
+
+    def init(key):
+        return {"w": jnp.zeros((n_features, num_classes))}
+
+    def apply(params, x):
+        return x @ params["w"]
+
+    return Model(init=init, apply=apply, wrap_delta=lambda d: d, name="lin")
+
+
+def test_per_example_clip_bound_exact():
+    """With σ=0 the DP-SGD batch gradient must equal the mean of the
+    per-example gradients each clipped to C — verified against a
+    hand-rolled oracle on a linear model."""
+    import optax
+
+    from qfedx_tpu.fed.client import _make_dp_example_grad
+    from qfedx_tpu.fed.config import FedConfig
+
+    clip = 0.05
+    model = _linear_model()
+    cfg = FedConfig(
+        dp=DPConfig(clip_norm=clip, noise_multiplier=0.0, mode="example")
+    )
+    grad_fn = _make_dp_example_grad(model, cfg)
+
+    rng = np.random.default_rng(0)
+    b, f = 8, 4
+    x = jnp.asarray(rng.normal(size=(b, f)) * 5.0, dtype=jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, b), dtype=jnp.int32)
+    mask = jnp.asarray([1, 1, 1, 1, 1, 1, 0, 0], dtype=jnp.float32)
+    params = {"w": jnp.asarray(rng.normal(size=(f, 2)) * 0.1, jnp.float32)}
+
+    _, got = grad_fn(params, params, x, y, mask, jax.random.PRNGKey(0))
+
+    def one_grad(xi, yi):
+        g = jax.grad(
+            lambda p: optax.softmax_cross_entropy_with_integer_labels(
+                (xi[None] @ p["w"])[0], yi
+            )
+        )(params)
+        norm = float(trees.global_norm(g))
+        return jax.tree.map(lambda t: t * min(1.0, clip / norm), g)
+
+    want = trees.tree_zeros_like(params)
+    for i in range(b):
+        if float(mask[i]) > 0:
+            want = trees.tree_add(want, one_grad(x[i], y[i]))
+    want = trees.tree_scale(want, 1.0 / b)  # lot size stays B under padding
+    np.testing.assert_allclose(
+        np.asarray(got["w"]), np.asarray(want["w"]), atol=1e-6
+    )
+    # Every surviving contribution is ≤ C/B in norm, so the bound holds.
+    assert float(trees.global_norm(got)) <= clip * b / b + 1e-6
+
+
+def test_per_example_noise_scale():
+    """σ>0: noise std on the batch gradient is σ·C/B (lot-size normalized)."""
+    from qfedx_tpu.fed.client import _make_dp_example_grad
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.models.api import Model
+
+    n = 20000
+    model = Model(
+        init=lambda k: {"w": jnp.zeros(n)},
+        apply=lambda p, x: jnp.zeros((x.shape[0], 2)) + p["w"][:2],
+        wrap_delta=lambda d: d,
+        name="null",
+    )
+    sigma, clip, b = 2.0, 0.5, 4
+    cfg = FedConfig(dp=DPConfig(clip_norm=clip, noise_multiplier=sigma,
+                                mode="example"))
+    grad_fn = _make_dp_example_grad(model, cfg)
+    x = jnp.zeros((b, 3))
+    y = jnp.zeros((b,), dtype=jnp.int32)
+    mask = jnp.zeros((b,))  # zero signal: output is pure noise / B
+    params = {"w": jnp.zeros(n)}
+    _, g = grad_fn(params, params, x, y, mask, jax.random.PRNGKey(1))
+    std = float(jnp.std(g["w"]))
+    assert std == pytest.approx(sigma * clip / b, rel=0.05)
+
+
+def test_example_mode_accountant_composition():
+    """Per-local-step composition: E epochs × n_batches steps per round at
+    q = p·B/S must give the same ε as the manual per-step loop."""
+    sigma, q, rounds, epochs, n_batches = 1.2, 0.25, 6, 2, 3
+    acct = RDPAccountant()
+    for _ in range(rounds):
+        acct.step(q=q, sigma=sigma, num_steps=epochs * n_batches)
+    manual = RDPAccountant()
+    for _ in range(rounds * epochs * n_batches):
+        manual.step(q=q, sigma=sigma)
+    assert acct.epsilon(1e-5) == pytest.approx(manual.epsilon(1e-5), rel=1e-9)
+    # and it is strictly more spend than one client-level step per round
+    client = RDPAccountant()
+    for _ in range(rounds):
+        client.step(q=1.0, sigma=sigma)
+    assert acct.epsilon(1e-5) != client.epsilon(1e-5)
+
+
+def test_spsa_rejects_example_mode():
+    from qfedx_tpu.fed.config import FedConfig
+
+    with pytest.raises(ValueError, match="spsa"):
+        FedConfig(optimizer="spsa",
+                  dp=DPConfig(mode="example"))
+
+
+def test_example_mode_trains_above_chance_single_digit_eps():
+    """Config-2-shaped run (DP-SGD, non-IID) learns above chance while the
+    accountant reports single-digit ε — the BASELINE config 2 contract."""
+    from qfedx_tpu.data.datasets import load_dataset
+    from qfedx_tpu.data.partition import dirichlet_partition, pack_clients
+    from qfedx_tpu.data.pipeline import preprocess
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+    from qfedx_tpu.run.trainer import train_federated
+
+    _, tr, te = load_dataset("mnist", synthetic_train=2560, synthetic_test=256,
+                             seed=3)
+    pre = preprocess(tr, te, classes=(0, 1), features="pca", n_features=4)
+    parts = dirichlet_partition(pre.train[1], 4, alpha=2.0, seed=1)
+    cx, cy, cmask = pack_clients(*pre.train, parts, pad_multiple=32)
+    model = make_vqc_classifier(n_qubits=4, n_layers=2, num_classes=2)
+    cfg = FedConfig(
+        local_epochs=1, batch_size=32, learning_rate=0.15, optimizer="adam",
+        dp=DPConfig(clip_norm=0.5, noise_multiplier=3.0, mode="example"),
+    )
+    res = train_federated(model, cfg, cx, cy, cmask, *pre.test,
+                          num_rounds=8, seed=0, eval_every=8)
+    assert res.final_accuracy > 0.7
+    assert 0 < res.epsilons[-1] < 10.0
