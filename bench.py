@@ -343,24 +343,34 @@ def _bench_time_to_target(jax, target=0.90, max_rounds=40):
     model = make_vqc_classifier(n_qubits=8, n_layers=3, num_classes=2)
     cfg = FedConfig(local_epochs=2, batch_size=32, learning_rate=0.1, optimizer="adam")
 
-    state = {"t0": None, "hit_s": None, "hit_round": None}
-
-    def watch(rnd, metrics):
-        if state["hit_s"] is None and metrics.get("accuracy", 0.0) >= target:
-            state["hit_s"] = time.perf_counter() - state["t0"]
-            state["hit_round"] = rnd + 1
-
-    state["t0"] = time.perf_counter()
-    train_federated(
+    t0 = time.perf_counter()
+    # Scanned dispatch with ON-DEVICE per-round eval (rounds_per_call):
+    # accuracy at every round comes out of the same device program, so
+    # the timed window is training + in-scan eval, not 40 host eval
+    # round-trips. The hit round is exact (per-round accuracies from the
+    # scan); the hit TIME is the sum of recorded per-round wall times up
+    # to it (chunk compiles amortize into their chunk's rounds — the
+    # persistent cache makes them ~free after the first bench run).
+    res = train_federated(
         model, cfg, cx, cy, cmask, *pre.test, num_rounds=max_rounds,
-        eval_every=1, seed=0, on_round_end=watch,
+        eval_every=1, seed=0, rounds_per_call=10,
     )
-    total = time.perf_counter() - state["t0"]
+    total = time.perf_counter() - t0
+    # accuracies[0] is the round-0 (pre-training) eval.
+    hit_round = next(
+        (i for i, a in enumerate(res.accuracies) if i > 0 and a >= target),
+        None,
+    )
+    hit_s = (
+        round(sum(res.round_times_s[:hit_round]), 3)
+        if hit_round is not None
+        else None
+    )
     return {
         "target_accuracy": target,
-        "seconds": round(state["hit_s"], 3) if state["hit_s"] is not None else None,
-        "rounds": state["hit_round"],
-        "reached": state["hit_s"] is not None,
+        "seconds": hit_s,
+        "rounds": hit_round,
+        "reached": hit_round is not None,
         "total_s_40_rounds": round(total, 3),
     }
 

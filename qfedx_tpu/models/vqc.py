@@ -123,15 +123,18 @@ def make_vqc_classifier(
         if circuit_noise:
             eval_noise = eval_noise.composed(n_layers)
 
-    # Fused whole-circuit kernel (ops.fused_hea): the plain angle-encoded
-    # HEA forward+backward as ONE VMEM-resident Pallas program instead of
-    # ~2·L·n HBM passes. Exact same circuit, so it is a pure performance
-    # routing. The decision is made lazily at first apply (not at model
-    # build) because the auto-route probes the backend platform — doing
-    # that at build time would initialize the backend as a side effect,
-    # pinning the platform before callers could select one.
-    fused_candidate = (
-        encoding == "angle" and basis == "ry" and noise_model is None
+    # Fused whole-circuit kernel (ops.fused_hea): the angle-encoded HEA —
+    # and the data-reuploading variant (per-sample in-kernel encoder
+    # gates; needs L·n ≤ 128 angle columns) — forward+backward as ONE
+    # VMEM-resident Pallas program instead of ~2·L·n HBM passes. Exact
+    # same circuit, so it is a pure performance routing. The decision is
+    # made lazily at first apply (not at model build) because the
+    # auto-route probes the backend platform — doing that at build time
+    # would initialize the backend as a side effect, pinning the platform
+    # before callers could select one.
+    fused_candidate = noise_model is None and (
+        (encoding == "angle" and basis == "ry")
+        or (encoding == "reupload" and n_layers * n_qubits <= 128)
     )
     _fused_cell: list = []
 
@@ -144,13 +147,27 @@ def make_vqc_classifier(
 
     def apply(params, x):
         if _use_fused():
-            from qfedx_tpu.ops.fused_hea import hea_zexp
+            a = params["ansatz"]
+            if encoding == "reupload":
+                from qfedx_tpu.ops.fused_hea import hea_reupload_zexp
 
-            enc = jax.vmap(lambda xi: angle_encode(xi, basis).re.reshape(-1))(x)
-            zexp = hea_zexp(
-                params["ansatz"]["rx"], params["ansatz"]["rz"], enc,
-                n_qubits, n_layers,
-            )
+                # Per-sample encoder angles a_{l,q} = enc_w·(π·x) + enc_b,
+                # computed here in plain JAX so autodiff chains the
+                # kernel's angle cotangent to enc_w/enc_b/x.
+                ang = (
+                    a["enc_w"][None] * (x[:, None, :] * jnp.pi)
+                    + a["enc_b"][None]
+                ).reshape(x.shape[0], n_layers * n_qubits)
+                zexp = hea_reupload_zexp(
+                    a["rx"], a["rz"], ang, n_qubits, n_layers
+                )
+            else:
+                from qfedx_tpu.ops.fused_hea import hea_zexp
+
+                enc = jax.vmap(
+                    lambda xi: angle_encode(xi, basis).re.reshape(-1)
+                )(x)
+                zexp = hea_zexp(a["rx"], a["rz"], enc, n_qubits, n_layers)
             z = zexp[:, : params["readout"]["scale"].shape[0]]
             return params["readout"]["scale"] * z + params["readout"]["bias"]
 

@@ -34,7 +34,9 @@ Mosaic compile time becomes impractical — see MAX_QUBITS). Everything
 else falls back to the per-gate engine. Routing: `fused_enabled()`
 (QFEDX_FUSED=1 forces on, =0 forces off; unset → on-TPU auto for
 n ≥ AUTO_MIN_QUBITS, the measured-win regime). v5e measurements (batch
-64, 3 layers, fwd+grad): 1.41× vs the XLA path at n=16, parity at ≤12.
+64, 3 layers, fwd+grad; benchmarks/fused_sweep.json): 1.50× vs the XLA
+path at n=16, 1.27× at 14 (1.58×/1.35× with QFEDX_DTYPE=bf16), 0.89×
+at 12 (dispatch-bound — the XLA path keeps it).
 """
 
 from __future__ import annotations
@@ -55,11 +57,13 @@ MIN_QUBITS = 8
 # — not shippable today; the sv-sharded engine covers that regime.
 MAX_QUBITS = 16
 # Auto-route threshold, set from v5e measurement (fwd+grad, batch 64, 3
-# layers; benchmarks/fused_sweep.py): n=12 → 1.02× vs XLA (dispatch-
-# bound, not worth the compile), n=14 → 1.11×, n=16 → 1.41× and growing
-# with n as the XLA path goes HBM-bound and its autodiff tape approaches
-# HBM capacity. Below the threshold QFEDX_FUSED=1 still forces the path.
-AUTO_MIN_QUBITS = 16
+# layers; benchmarks/fused_sweep.py, after the round-3 readout/λ-seed
+# matmul restructure): n=12 → 0.89× vs XLA (dispatch-bound, fused
+# loses), n=14 → 1.27×, n=16 → 1.50× (1.35×/1.58× with bf16) and
+# growing with n as the XLA path goes HBM-bound and its autodiff tape
+# approaches HBM capacity. Below the threshold QFEDX_FUSED=1 still
+# forces the path.
+AUTO_MIN_QUBITS = 14
 
 _INTERPRET = False  # flipped by tests on CPU
 # Trace-time flag (set by the host wrappers while tracing a kernel whose
@@ -679,6 +683,253 @@ def _hea_bwd(n_qubits, n_layers, res, ct):
 
 
 hea_zexp.defvjp(_hea_fwd, _hea_bwd)
+
+
+# --------------------------------------------------------------------------
+# Data-reuploading variant (BASELINE config 4; reference ROADMAP.md:20-23).
+#
+# The circuit is L × [per-qubit RY(a_{l,q}) re-encode → rot_zx layer → CNOT
+# ring] with PER-SAMPLE encoder angles a = enc_w·(π·x) + enc_b computed
+# outside the kernel in plain JAX (so autodiff chains d_angles → enc_w,
+# enc_b, x for free). Per-sample gates cannot share one SMEM-scalar gate
+# matrix across the batch block; instead the angle block rides in VMEM as
+# a (BB, 128) slab (flat column l·n+q — needs L·n ≤ 128) and each gate's
+# per-sample cos/sin arrive as (BB, 128) all-columns-equal broadcasts
+# built by a one-hot column-select matmul — rank-2 arrays the whole way,
+# so the Mosaic program again does not grow with BB. RY is real, so the
+# per-sample application touches x and y slabs identically. Layers are
+# UNROLLED (the fori-loop trick would need dynamic lane indexing for the
+# angle columns); config-4 widths (n ≈ 12) compile fine unrolled.
+# --------------------------------------------------------------------------
+
+
+def _col_select(col: int):
+    """(128, 128) with row ``col`` all-ones: A @ M broadcasts column
+    ``col`` of A to every output column (all-equal broadcast)."""
+    i = jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 0)
+    return jnp.where(i == col, 1.0, 0.0).astype(jnp.float32)
+
+
+def _col_onehot_row(col: int):
+    """(1, 128) one-hot mask selecting output column ``col``."""
+    j = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    return jnp.where(j == col, 1.0, 0.0).astype(jnp.float32)
+
+
+def _row_total(partial):
+    """(BB, 128) → (BB, 128) with every column = the row sum (an all-equal
+    broadcast of the per-sample total, via a ones matmul — keeps rank 2)."""
+    ones = jnp.ones((LANES, LANES), dtype=jnp.float32)
+    return _dot(partial, ones)
+
+
+def _apply_2x2_real_persample(x, y, n: int, q: int, e00, e01, e10, e11):
+    """Apply a REAL per-sample 2×2 [[e00,e01],[e10,e11]] on qubit q; the
+    entries are (BB, 128) all-columns-equal broadcasts. Real matrix ⇒ x
+    and y slabs transform identically and independently."""
+    if q <= n - LANE_QUBITS - 1:  # row qubit — VPU
+        c4 = lambda e: e[:, None, None, :]  # (BB,1,1,128)
+        xs, ys = _split_row(x, n, q), _split_row(y, n, q)
+        x0, x1 = xs[:, :, 0], xs[:, :, 1]
+        y0, y1 = ys[:, :, 0], ys[:, :, 1]
+        nx0 = c4(e00) * x0 + c4(e01) * x1
+        nx1 = c4(e10) * x0 + c4(e11) * x1
+        ny0 = c4(e00) * y0 + c4(e01) * y1
+        ny1 = c4(e10) * y0 + c4(e11) * y1
+        return _join_row(nx0, nx1), _join_row(ny0, ny1)
+    # Lane qubit: out_l = E[b_l, b_l]·v_l + E[b_l, 1−b_l]·v_{l^m} — the
+    # flip partner comes from ONE fixed permutation matmul shared by all
+    # samples; per-sample entries select via the lane-bit mask.
+    p = _lane_bitpos(n, q)
+    pf = _lane_perm_flip(p)
+    xf, yf = _matmul_lanes2(x, y, pf)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    bit1 = (((lane >> p) & 1) == 1).astype(jnp.float32)  # (1,128)
+    diag = (1.0 - bit1) * e00 + bit1 * e11  # (BB,128)
+    off = (1.0 - bit1) * e01 + bit1 * e10
+    c3 = lambda e: e[:, None, :]  # (BB,1,128)
+    return c3(diag) * x + c3(off) * xf, c3(diag) * y + c3(off) * yf
+
+
+def _angle_cs(ang, l: int, n: int, q: int, sign: float = 1.0):
+    """cos/sin(±a_{l,q}/2) as (BB, 128) all-equal broadcasts from the flat
+    angle block (column l·n+q)."""
+    col = _dot(ang, _col_select(l * n + q))
+    half = 0.5 * col
+    return jnp.cos(half), sign * jnp.sin(half)
+
+
+def _reup_fwd_kernel(n: int, n_layers: int, save_state: bool,
+                     rx_ref, rz_ref, ang_ref, zexp_ref,
+                     xf_ref=None, yf_ref=None):
+    ang = ang_ref[...][0]  # (BB, 128) f32
+    bb = ang.shape[0]
+    r = 1 << (n - LANE_QUBITS)
+    # |0…0⟩: amplitude 1 at row 0, lane 0.
+    ri = jax.lax.broadcasted_iota(jnp.int32, (r, LANES), 0)
+    li = jax.lax.broadcasted_iota(jnp.int32, (r, LANES), 1)
+    x = jnp.where((ri == 0) & (li == 0), 1.0, 0.0).astype(jnp.float32)
+    x = jnp.broadcast_to(x[None], (bb, r, LANES))
+    y = jnp.zeros_like(x)
+    for l in range(n_layers):
+        for q in range(n):  # per-sample RY re-encode
+            c, s = _angle_cs(ang, l, n, q)
+            x, y = _apply_2x2_real_persample(x, y, n, q, c, -s, s, c)
+        for q in range(n):  # shared variational rot_zx
+            ur, ui = _rot_entries(rx_ref[l, q], rz_ref[l, q])
+            x, y = _apply_rot(x, y, n, q, ur, ui)
+        x, y = _entangle_ring(x, y, n)
+    zexp_ref[...] = _zexp_block(x * x + y * y, n)[None]
+    if save_state:
+        xf_ref[...] = x.astype(xf_ref.dtype)
+        yf_ref[...] = y.astype(yf_ref.dtype)
+
+
+def _reup_bwd_kernel(n: int, n_layers: int,
+                     rx_ref, rz_ref, ang_ref, xf_ref, yf_ref, ct_ref,
+                     drx_ref, drz_ref, dang_ref):
+    ang = ang_ref[...][0]
+    x = xf_ref[...].astype(jnp.float32)
+    y = yf_ref[...].astype(jnp.float32)
+    r = x.shape[1]
+    s_seed = _lambda_seed(ct_ref[...][0], n, r)
+    lx, ly = 2.0 * s_seed * x, 2.0 * s_seed * y
+
+    @pl.when(pl.program_id(0) == 0)
+    def _zero():
+        for l in range(n_layers):
+            for q in range(n):
+                drx_ref[l, q] = jnp.float32(0.0)
+                drz_ref[l, q] = jnp.float32(0.0)
+
+    dang = jnp.zeros_like(ang)  # (BB, 128) register accumulator
+    for l in reversed(range(n_layers)):
+        x, y = _entangle_ring_reverse(x, y, n)
+        lx, ly = _entangle_ring_reverse(lx, ly, n)
+        for q in reversed(range(n)):
+            theta, phi = rx_ref[l, q], rz_ref[l, q]
+            ur, ui = _rot_entries_adjoint(theta, phi)
+            x, y = _apply_rot(x, y, n, q, ur, ui)  # uncompute
+            wrr, wri = _w_matrices(n, q, lx, ly, x, y)
+            dth, dph = _rot_derivs(theta, phi)
+            drx_ref[l, q] += _contract_w(dth, wrr, wri)
+            drz_ref[l, q] += _contract_w(dph, wrr, wri)
+            lx, ly = _apply_rot(lx, ly, n, q, ur, ui)
+        for q in reversed(range(n)):  # per-sample RY encode gates
+            c, s = _angle_cs(ang, l, n, q)
+            # uncompute with RY(−a)
+            x, y = _apply_2x2_real_persample(x, y, n, q, c, s, -s, c)
+            # dU/da = ½[[−s, −c],[c, −s]] (real); v = (dU)ψ_pre, then the
+            # per-sample reduction d_b = Σ λ·v over all amplitudes.
+            h = jnp.float32(0.5)
+            vx, vy = _apply_2x2_real_persample(
+                x, y, n, q, -h * s, -h * c, h * c, -h * s
+            )
+            partial = jnp.sum(lx * vx + ly * vy, axis=1)  # (BB, 128)
+            dang = dang + _row_total(partial) * _col_onehot_row(l * n + q)
+            lx, ly = _apply_2x2_real_persample(lx, ly, n, q, c, s, -s, c)
+    dang_ref[...] = dang[None]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def hea_reupload_zexp(rx: jnp.ndarray, rz: jnp.ndarray, angles: jnp.ndarray,
+                      n_qubits: int, n_layers: int) -> jnp.ndarray:
+    """⟨Z_k⟩ of the data-reuploading HEA circuit, fused.
+
+    rx, rz: (L, n) shared rotation angles. angles: (B, L·n) PER-SAMPLE
+    encoder angles (a_{l,q} at flat column l·n+q; needs L·n ≤ 128),
+    typically enc_w·(π·x) + enc_b computed in plain JAX so its VJP chains
+    to enc_w/enc_b/x automatically. Returns (B, n). Differentiable in all
+    three tensor args (adjoint backward; the per-sample angle cotangent
+    is accumulated in-kernel)."""
+    (zexp,) = _reup_fwd_call(rx, rz, angles, n_qubits, n_layers,
+                             save_state=False)
+    return zexp
+
+
+def _reup_pack(angles: jnp.ndarray, bb: int):
+    b, cols = angles.shape
+    ap = _pad_batch(angles.astype(jnp.float32), bb)
+    ap = jnp.concatenate(
+        [ap, jnp.zeros((ap.shape[0], LANES - cols), jnp.float32)], axis=1
+    )
+    return ap.reshape(-1, bb, LANES)
+
+
+def _reup_fwd_call(rx, rz, angles, n_qubits, n_layers, save_state):
+    n, el = n_qubits, n_layers
+    if el * n > LANES:
+        raise ValueError(
+            f"fused reupload needs L·n ≤ {LANES}; got {el}·{n}"
+        )
+    b = angles.shape[0]
+    r = 1 << (n - LANE_QUBITS)
+    bb = _block_batch(n, b, heavy=save_state)
+    angp = _reup_pack(angles, bb)
+    bp = angp.shape[0] * bb
+    grid = (bp // bb,)
+    kernel = functools.partial(_reup_fwd_kernel, n, el, save_state)
+    smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
+    slab = lambda: pl.BlockSpec((bb, r, LANES), lambda i: (i, 0, 0))
+    blk = lambda: pl.BlockSpec((1, bb, LANES), lambda i: (i, 0, 0))
+    zshape = jax.ShapeDtypeStruct((bp // bb, bb, LANES), jnp.float32)
+    sshape = jax.ShapeDtypeStruct((bp, r, LANES), jnp.float32)
+    out_specs = [blk()] + ([slab(), slab()] if save_state else [])
+    out_shape = [zshape] + ([sshape, sshape] if save_state else [])
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[smem(), smem(), blk()],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=_compiler_params(),
+        interpret=_INTERPRET,
+    )(rx, rz, angp)
+    return (outs[0].reshape(bp, LANES)[:b, :n],) + tuple(outs[1:])
+
+
+def _reup_fwd(rx, rz, angles, n_qubits, n_layers):
+    zexp, xf, yf = _reup_fwd_call(
+        rx, rz, angles, n_qubits, n_layers, save_state=True
+    )
+    return zexp, (rx, rz, angles, xf, yf)
+
+
+def _reup_bwd(n_qubits, n_layers, res, ct):
+    rx, rz, angles, xf, yf = res
+    n, el = n_qubits, n_layers
+    r = 1 << (n - LANE_QUBITS)
+    bp = xf.shape[0]
+    bb = _block_batch(n, bp, heavy=True)
+    angp = _reup_pack(angles, bb)
+    ctp = _pad_batch(ct, bb)
+    ctp = jnp.concatenate(
+        [ctp, jnp.zeros((bp, LANES - ctp.shape[1]), ctp.dtype)], axis=1
+    ).reshape(bp // bb, bb, LANES)
+    grid = (bp // bb,)
+    kernel = functools.partial(_reup_bwd_kernel, n, el)
+    smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
+    slab = lambda: pl.BlockSpec((bb, r, LANES), lambda i: (i, 0, 0))
+    blk = lambda: pl.BlockSpec((1, bb, LANES), lambda i: (i, 0, 0))
+    acc = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
+    drx, drz, dangp = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[smem(), smem(), blk(), slab(), slab(), blk()],
+        out_specs=[acc(), acc(), blk()],
+        out_shape=[
+            jax.ShapeDtypeStruct((el, n), jnp.float32),
+            jax.ShapeDtypeStruct((el, n), jnp.float32),
+            jax.ShapeDtypeStruct((bp // bb, bb, LANES), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=_INTERPRET,
+    )(rx, rz, angp, xf, yf, ctp)
+    dang = dangp.reshape(bp, LANES)[: ct.shape[0], : angles.shape[1]]
+    return drx, drz, dang.astype(angles.dtype)
+
+
+hea_reupload_zexp.defvjp(_reup_fwd, _reup_bwd)
 
 
 # --------------------------------------------------------------------------

@@ -59,7 +59,12 @@ def product_state(amps: CArray) -> CArray:
     state = qubit(0)
     for k in range(1, n):
         state = outer(state, qubit(k))
-    return state
+        if n >= _FLAT_RANK:
+            # Keep intermediates rank-2 at high qubit counts (the outer
+            # product is then a (2^k, 1)×(1, 2) broadcast — see _FLAT_RANK
+            # for why rank-n intermediates are poison for the compiler).
+            state = _creshape(state, (-1,))
+    return _creshape(state, (2,) * n) if n >= _FLAT_RANK else state
 
 
 def _contract_move(g: jnp.ndarray, s: jnp.ndarray, axes, src, dst) -> jnp.ndarray:
@@ -92,13 +97,46 @@ def _apply(gate: CArray, state: CArray, axes, src, dst) -> CArray:
     )
 
 
+# Above this rank the (2,)*n tensor form hits an XLA compile wall: layout
+# assignment and op lowering cost grow badly with tensor rank (measured on
+# the v5e toolchain: n=16 compiles in ~30s, n≥18 ran >20 minutes without
+# finishing). High-rank states therefore contract through rank-3/rank-5
+# reshaped VIEWS (row-major bit split around the target axes — pure
+# reshapes, free at the XLA level), keeping every dot at small rank.
+_FLAT_RANK = 15
+
+
+def _creshape(c: CArray, shape) -> CArray:
+    return CArray(
+        c.re.reshape(shape), None if c.im is None else c.im.reshape(shape)
+    )
+
+
 def apply_gate(state: CArray, gate: CArray, qubit: int) -> CArray:
     """Apply a (2,2) gate to axis ``qubit`` of a (2,)*n state."""
+    n = state.ndim
+    if n >= _FLAT_RANK:
+        shape = state.shape
+        a, c = 1 << qubit, 1 << (n - qubit - 1)
+        flat = _creshape(state, (a, 2, c))
+        out = _apply(gate, flat, ((1,), (1,)), 0, 1)
+        return _creshape(out, shape)
     return _apply(gate, state, ((1,), (qubit,)), 0, qubit)
 
 
 def apply_gate_2q(state: CArray, gate: CArray, q1: int, q2: int) -> CArray:
     """Apply a (2,2,2,2) gate tensor G[o1,o2,i1,i2] to axes (q1, q2)."""
+    n = state.ndim
+    if n >= _FLAT_RANK:
+        shape = state.shape
+        lo, hi = (q1, q2) if q1 < q2 else (q2, q1)
+        a = 1 << lo
+        m = 1 << (hi - lo - 1)
+        c = 1 << (n - hi - 1)
+        flat = _creshape(state, (a, 2, m, 2, c))
+        ax1, ax2 = (1, 3) if q1 < q2 else (3, 1)
+        out = _apply(gate, flat, ((2, 3), (ax1, ax2)), (0, 1), (ax1, ax2))
+        return _creshape(out, shape)
     return _apply(gate, state, ((2, 3), (q1, q2)), (0, 1), (q1, q2))
 
 
@@ -128,6 +166,14 @@ def expect_z_all(state: CArray) -> jnp.ndarray:
     probs = cabs2(state)
     n = probs.ndim
     out = []
+    if n >= _FLAT_RANK:  # rank-3 marginals (see _FLAT_RANK)
+        for k in range(n):
+            a, c = 1 << k, 1 << (n - k - 1)
+            marg = jnp.sum(
+                probs.reshape(a, 2, c), axis=(0, 2), dtype=jnp.float32
+            )
+            out.append(marg[0] - marg[1])
+        return jnp.stack(out)
     for k in range(n):
         axes = tuple(i for i in range(n) if i != k)
         marg = jnp.sum(probs, axis=axes, dtype=jnp.float32)

@@ -31,12 +31,14 @@ class DataConfig:
     partition: str = "iid"  # iid | dirichlet
     alpha: float = 0.5  # Dirichlet concentration (ROADMAP.md:106)
     seed: int = 42
-    # Synthetic-fallback sizes (used only when raw files are absent).
+    # Synthetic-fallback knobs (used only when raw files are absent).
     # Per-example DP-SGD cells need realistic per-client dataset sizes:
-    # the accountant's sampling rate is B/S_min, so a tiny synthetic set
-    # inflates ε regardless of σ.
+    # the accountant's sampling rate is B/S_pad, so a tiny synthetic set
+    # inflates ε regardless of σ. synthetic_noise sets task separability
+    # (the generator's label-noise scale).
     synthetic_train: int = 4096
     synthetic_test: int = 1024
+    synthetic_noise: float = 0.25
 
 
 @dataclass(frozen=True)
@@ -221,6 +223,7 @@ def build_data(cfg: ExperimentConfig) -> dict[str, Any]:
     spec, train_xy, test_xy = load_dataset(
         d.dataset, d.raw_folder, seed=d.seed,
         synthetic_train=d.synthetic_train, synthetic_test=d.synthetic_test,
+        synthetic_noise=d.synthetic_noise,
     )
     prep = preprocess(
         train_xy,

@@ -83,6 +83,15 @@ def preset_cells(preset: str) -> list[dict]:
                 _cell(f"q4-dp{sigma}", qubits=4, clients=8,
                       dp_sigma=sigma, dp_clip=1.0, **bi)
             )
+        # Per-example DP-SGD point (dp mode "example"): puts a LEARNING
+        # point at single-digit ε on the accuracy-vs-ε curve — the
+        # client-level σ axis above only reaches single digits at σ=2,
+        # where it has degraded to chance.
+        cells.append(
+            _cell("q4-dpsgd", qubits=4, clients=8, dp_sigma=1.4, dp_clip=1.0,
+                  dp_mode="example", batch_size=64, local_epochs=2,
+                  lr=0.2, rounds=10, synthetic_train=16384, **bi)
+        )
         # Real-data cells (ROADMAP.md:104 names Iris explicitly): the
         # bundled Iris table — the sweep's only guaranteed-real dataset in
         # a zero-egress environment — binary (setosa vs versicolor) and
@@ -117,15 +126,22 @@ def preset_cells(preset: str) -> list[dict]:
             # synthetic_train raised: ε composes at q = B/S_pad, so
             # realistic per-client dataset sizes are what make single-digit
             # ε reachable at all.
+            # Tuning notes (measured, 3 seeds): lot size 64 + 2 local
+            # epochs is what survives the noise — B=16 collapses to
+            # constant prediction at any σ; the no-DP ceiling of this
+            # task/shape is ~0.91, clip-only ~0.88.
             _cell("c2-8q-dpsgd", qubits=8, clients=10, partition="dirichlet",
-                  alpha=1.0, classes=(0, 1), dp_sigma=1.0, dp_clip=1.0,
-                  dp_mode="example", lr=0.2, rounds=16, batch_size=16,
-                  synthetic_train=16384),
+                  alpha=1.0, classes=(0, 1), dp_sigma=1.2, dp_clip=1.0,
+                  dp_mode="example", lr=0.2, rounds=10, batch_size=64,
+                  local_epochs=2, synthetic_train=16384),
             # Config 3 is CIFAR-10: route the real loader (32×32×3 shape
             # contract; synthetic fallback keeps that shape when raw CIFAR
-            # files are absent — this environment has no egress).
+            # files are absent — this environment has no egress). lr at the
+            # reference's CNN scale (Classical_FL.py lr=0.01) — the
+            # harness-wide 0.1 left this cell near chance.
             _cell("c3-cnn-fedprox", model="cnn", dataset="cifar10",
-                  clients=32, algorithm="fedprox", prox_mu=0.01, rounds=4),
+                  clients=32, algorithm="fedprox", prox_mu=0.01, rounds=6,
+                  lr=0.01),
             _cell("c4-12q-reupload-secagg", qubits=12, clients=64,
                   encoding="reupload", secure_agg=True, rounds=4),
             _cell("c5-svqc", qubits=8, clients=32, sv_size=4, rounds=16,
@@ -158,6 +174,7 @@ def _config_from_cell(cell: dict, seed: int) -> ExperimentConfig:
             alpha=cell.get("alpha", 0.5),
             seed=seed,
             synthetic_train=cell.get("synthetic_train", 4096),
+            synthetic_noise=cell.get("synthetic_noise", 0.25),
         ),
         model=ModelConfig(
             model=cell.get("model", "vqc"),

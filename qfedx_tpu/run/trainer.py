@@ -121,7 +121,12 @@ def train_federated(
     # save is a host action). The sv-sharded path keeps host evaluation
     # and the old clamp.
     requested_rpc = max(1, int(rounds_per_call))
-    in_scan_eval = requested_rpc > 1 and model.sv_size == 1
+    # eval_every > num_rounds is the "evaluation off" convention (same
+    # gate as the round-0 eval below): honor it in the scan too — no
+    # eval set upload, no per-round apply.
+    in_scan_eval = (
+        requested_rpc > 1 and model.sv_size == 1 and eval_every <= num_rounds
+    )
     rounds_per_call = min(
         requested_rpc,
         requested_rpc if in_scan_eval else eval_every,
@@ -342,4 +347,16 @@ def train_federated(
         rnd += chunk
 
     result.params = params
+    # The in-scan eval set may be capped (2048 default / eval_batches) —
+    # a pacing metric. The FINAL reported accuracy must cover the full
+    # eval set like the host evaluator always did: recompute it uncapped
+    # when the cap actually truncated.
+    if (
+        ex_dev is not None
+        and result.accuracies
+        and ex_dev.shape[0] < len(test_x)
+    ):
+        result.accuracies[-1] = evaluate_full(params, test_x, test_y)[
+            "accuracy"
+        ]
     return result

@@ -122,6 +122,94 @@ def test_model_fused_path_matches_default(monkeypatch):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
+# --- data-reuploading variant (BASELINE config 4) --------------------------
+
+
+def _dense_reup_zexp(params, x):
+    from qfedx_tpu.circuits.ansatz import data_reuploading
+
+    def one(xi):
+        return expect_z_all(data_reuploading(xi, params))
+
+    return jax.vmap(one)(x)
+
+
+def _fused_reup_zexp(params, x, n, layers):
+    ang = (
+        params["enc_w"][None] * (x[:, None, :] * jnp.pi) + params["enc_b"][None]
+    ).reshape(x.shape[0], layers * n)
+    return fh.hea_reupload_zexp(params["rx"], params["rz"], ang, n, layers)
+
+
+def _setup_reup(n, layers, batch, seed=0):
+    from qfedx_tpu.circuits.ansatz import init_reuploading_params
+
+    params = init_reuploading_params(
+        jax.random.PRNGKey(seed), n, layers, scale=0.4
+    )
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.uniform(0, 1, (batch, n)), dtype=jnp.float32)
+    return params, x
+
+
+@pytest.mark.parametrize("n,layers,batch", [(8, 2, 3), (10, 2, 4)])
+def test_reupload_forward_matches_dense(n, layers, batch):
+    params, x = _setup_reup(n, layers, batch)
+    got = _fused_reup_zexp(params, x, n, layers)
+    want = _dense_reup_zexp(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+@pytest.mark.parametrize("n,layers,batch", [(8, 2, 3)])
+def test_reupload_gradients_match_dense(n, layers, batch):
+    """Fused adjoint backward ≡ jax.grad through the dense engine for ALL
+    parameter leaves — including enc_w/enc_b/x, which chain through the
+    kernel's per-sample angle cotangent."""
+    params, x = _setup_reup(n, layers, batch, seed=2)
+    w = jnp.asarray(
+        np.random.default_rng(3).normal(size=(batch, n)), dtype=jnp.float32
+    )
+
+    def loss_fused(params_, x_):
+        return jnp.sum(w * _fused_reup_zexp(params_, x_, n, layers))
+
+    def loss_dense(params_, x_):
+        return jnp.sum(w * _dense_reup_zexp(params_, x_))
+
+    np.testing.assert_allclose(
+        float(loss_fused(params, x)), float(loss_dense(params, x)), atol=1e-5
+    )
+    gf, gfx = jax.grad(loss_fused, argnums=(0, 1))(params, x)
+    gd, gdx = jax.grad(loss_dense, argnums=(0, 1))(params, x)
+    for k in ("rx", "rz", "enc_w", "enc_b"):
+        np.testing.assert_allclose(
+            np.asarray(gf[k]), np.asarray(gd[k]), atol=3e-4, err_msg=k
+        )
+    np.testing.assert_allclose(np.asarray(gfx), np.asarray(gdx), atol=3e-4)
+
+
+def test_reupload_model_fused_matches_default(monkeypatch):
+    """make_vqc_classifier(encoding='reupload') with QFEDX_FUSED=1 ≡ the
+    default dense path end to end (the config-4 flagship route)."""
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    n, layers, batch = 8, 2, 5
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.uniform(0, 1, (batch, n)), dtype=jnp.float32)
+
+    monkeypatch.delenv("QFEDX_FUSED", raising=False)
+    base = make_vqc_classifier(n_qubits=n, n_layers=layers, num_classes=2,
+                               encoding="reupload")
+    params = base.init(jax.random.PRNGKey(0))
+    want = base.apply(params, x)
+
+    monkeypatch.setenv("QFEDX_FUSED", "1")
+    fused = make_vqc_classifier(n_qubits=n, n_layers=layers, num_classes=2,
+                                encoding="reupload")
+    got = fused.apply(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
 def test_routing(monkeypatch):
     monkeypatch.delenv("QFEDX_FUSED", raising=False)
     assert not fh.fused_eligible(7)  # needs a full 128-lane dim

@@ -224,3 +224,40 @@ def test_engine_jits_and_vmaps():
     out = jax.jit(jax.vmap(circuit))(thetas)
     assert out.shape == (2,)
     np.testing.assert_allclose(np.asarray(out[0]), np.asarray(circuit(thetas[0])), atol=1e-6)
+
+
+def test_flat_rank_path_matches_tensor_path(monkeypatch):
+    """apply_gate/apply_gate_2q/expect_z_all via the rank-3/5 reshaped
+    views (_FLAT_RANK, the ≥15-qubit XLA-compile-wall workaround) must be
+    bit-compatible with the (2,)*n tensor form — forced here at small n by
+    lowering the threshold."""
+    import qfedx_tpu.ops.statevector as sv
+    from qfedx_tpu.circuits.ansatz import hardware_efficient, init_ansatz_params
+    from qfedx_tpu.circuits.encoders import angle_encode
+
+    n = 5
+    params = init_ansatz_params(jax.random.PRNGKey(0), n, 2, scale=0.7)
+    x = jnp.asarray(np.random.default_rng(0).uniform(0, 1, (n,)), jnp.float32)
+
+    def run():
+        state = hardware_efficient(angle_encode(x), params)
+        return sv.expect_z_all(state)
+
+    want = run()
+    monkeypatch.setattr(sv, "_FLAT_RANK", 1)
+    got = run()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+    def grads(fn):
+        def loss(p):
+            state = hardware_efficient(angle_encode(x), p)
+            return jnp.sum(sv.expect_z_all(state) * jnp.arange(1.0, n + 1))
+        return jax.grad(loss)(params)
+
+    g_flat = grads(run)
+    monkeypatch.setattr(sv, "_FLAT_RANK", 15)
+    g_tensor = grads(run)
+    for k in g_flat:
+        np.testing.assert_allclose(
+            np.asarray(g_flat[k]), np.asarray(g_tensor[k]), atol=1e-6
+        )
