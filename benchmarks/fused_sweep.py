@@ -55,13 +55,22 @@ def timeit(n_qubits, n_layers=3, batch=64, reps=5):
     fn, params, steps = build_step(n_qubits, n_layers, batch)
     _, ls = fn(params)
     jax.block_until_ready(ls)
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        _, ls = fn(params)
-        jax.block_until_ready(ls)
-        times.append(time.perf_counter() - t0)
-    return sorted(times)[len(times) // 2] / steps
+
+    def measure():
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _, ls = fn(params)
+            jax.block_until_ready(ls)
+            times.append(time.perf_counter() - t0)
+        return sorted(times)[len(times) // 2] / steps
+
+    t = measure()
+    # Transient tunnel glitches have produced ~0s timings (see the same
+    # guard in bench.py); this workload cannot run in <1ms per step.
+    if t < 1e-3:
+        t = measure()
+    return t
 
 
 def with_env(var, val, fn, *a):

@@ -59,10 +59,10 @@ MAX_QUBITS = 16
 # Auto-route threshold, set from v5e measurement (fwd+grad, batch 64, 3
 # layers; benchmarks/fused_sweep.py, after the round-3 readout/λ-seed
 # matmul restructure): n=12 → 0.89× vs XLA (dispatch-bound, fused
-# loses), n=14 → 1.27×, n=16 → 1.50× (1.35×/1.58× with bf16) and
-# growing with n as the XLA path goes HBM-bound and its autodiff tape
-# approaches HBM capacity. Below the threshold QFEDX_FUSED=1 still
-# forces the path.
+# loses), n=14 → 1.27×, n=15 → 1.38×, n=16 → 1.50× (1.35×/1.36×/1.58×
+# with bf16) and growing with n as the XLA path goes HBM-bound and its
+# autodiff tape approaches HBM capacity. Below the threshold
+# QFEDX_FUSED=1 still forces the path.
 AUTO_MIN_QUBITS = 14
 
 _INTERPRET = False  # flipped by tests on CPU
