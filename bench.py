@@ -194,15 +194,17 @@ _PEAK_HBM_BPS = 819e9  # v5e HBM bandwidth
 
 
 def _dense_cost_model(n_qubits: int, n_layers: int, state_bytes: int = 4):
-    """(gates, est FLOPs, est HBM bytes) per sample-forward, from the
-    engine's real-pair contraction structure (ops/statevector.py).
+    """(gates, est FLOPs, est HBM bytes) per sample-forward — an analytic
+    PER-GATE STREAMING model, kept as a reference point, NOT a bound.
 
-    Fused RZ·RX rotation (complex 2×2): 4 real (2,2)×(2,2^{n-1})
-    contractions ≈ 16·2^n FLOPs + 2·2^n combine adds. CNOT (real 4×4, state
-    complex): 2 real (4,4)×(4,2^{n-2}) contractions ≈ 16·2^n FLOPs. Every
-    gate streams the full re+im state from HBM and back: ≈ 4·state_bytes·2^n
-    bytes (state_bytes = 4 for f32, 2 for QFEDX_DTYPE=bf16), the op's true
-    cost at this arithmetic intensity.
+    Rotation (complex 2×2 in flip/select form): ~18·2^n FLOPs; CNOT
+    (select/permutation): ~16·2^n FLOP-equivalents; each gate charged one
+    full re+im state round trip ≈ 4·state_bytes·2^n bytes (state_bytes =
+    4 f32, 2 bf16). The r04 slab engine BEATS this model's byte count —
+    XLA fuses consecutive row-qubit gates into shared passes (measured
+    device time below the per-gate streaming roofline; docs/PERF.md §2)
+    — so est_hbm_util can legitimately exceed what per-gate streaming
+    would allow and est_flop_util is meaningful only as a trend.
     """
     amps = 1 << n_qubits
     rot_gates = n_layers * n_qubits
@@ -410,10 +412,11 @@ def main():
         fused["speedup_vs_xla"] = round(
             compute["fwd_grad_s"] / fused["fwd_grad_s"], 3
         )
-    # bf16 state path (QFEDX_DTYPE=bf16): halves HBM traffic on the
-    # HBM-bound gate stream; fused additionally runs lane-gate matmuls on
-    # the MXU in bf16/f32-accumulate. Convergence parity is pinned by
-    # tests/test_bf16.py.
+    # bf16 state path (QFEDX_DTYPE=bf16): halves state bytes. Measured
+    # effect is width-dependent (docs/PERF.md §3): ~parity at n=16 (the
+    # slab engine is fusion/bubble-bound there), ~1.4× at n=18-20 where
+    # gate passes genuinely stream multi-MB states. Convergence parity is
+    # pinned by tests/test_bf16.py.
     compute_bf16 = safe(
         lambda j: _with_env(
             {"QFEDX_FUSED": "0", "QFEDX_DTYPE": "bf16"},
@@ -439,14 +442,44 @@ def main():
                 compute["fwd_grad_s"] / row["fwd_grad_s"], 3
             )
     # The 18–20-qubit dense frontier (reference ROADMAP.md:86), measured on
-    # the real chip: 20-qubit 3-layer XLA path with per-layer remat (the
-    # autodiff tape at 2^20 amps × 120 gates would not fit HBM otherwise).
+    # the real chip: 18q batch 16 (fits without remat on the slab engine),
+    # 20q batch 8 with per-layer remat (the autodiff tape at 2^20 amps ×
+    # 120 gates would not fit HBM otherwise) — each in f32 AND bf16, the
+    # regime where byte-halving measurably pays (VERDICT r03 item 4;
+    # docs/PERF.md §3).
+    dense18 = safe(
+        lambda j: _with_env(
+            {"QFEDX_FUSED": "0"}, _bench_compute_bound, j,
+            18, 3, 16, 3, 4, False,
+        )
+    )
+    dense18_bf16 = safe(
+        lambda j: _with_env(
+            {"QFEDX_FUSED": "0", "QFEDX_DTYPE": "bf16"},
+            _bench_compute_bound, j, 18, 3, 16, 3, 4, False,
+        )
+    )
     dense20 = safe(
         lambda j: _with_env(
             {"QFEDX_FUSED": "0"}, _bench_compute_bound, j,
             20, 3, 8, 3, 4, True,
         )
     )
+    dense20_bf16 = safe(
+        lambda j: _with_env(
+            {"QFEDX_FUSED": "0", "QFEDX_DTYPE": "bf16"},
+            _bench_compute_bound, j, 20, 3, 8, 3, 4, True,
+        )
+    )
+    for now, base in ((dense18_bf16, dense18), (dense20_bf16, dense20)):
+        if "fwd_grad_s" in now and "fwd_grad_s" in base:
+            now["speedup_vs_f32"] = round(
+                base["fwd_grad_s"] / now["fwd_grad_s"], 3
+            )
+            now["verdict"] = (
+                "better" if now["speedup_vs_f32"] >= 1.1 else
+                "worse" if now["speedup_vs_f32"] <= 0.9 else "parity"
+            )
     ttt = safe(_bench_time_to_target)
 
     # Headline: the trainer's optimized path (K rounds scanned per
@@ -455,6 +488,51 @@ def main():
     value = num_clients / scan_s / n_dev
     per_dispatch = num_clients / spmd_s / n_dev
     baseline_value = num_clients / seq_s / n_dev
+
+    # Round-over-round regression tracking (VERDICT r03 item 5): compare
+    # against the newest committed BENCH_r*.json so a drift in the
+    # headline / per-dispatch / engine rows is visible AT BENCH TIME (the
+    # r02→r03 −10% per-dispatch drift shipped unnoticed for a round).
+    vs_prev = {}
+    try:
+        import glob
+        import os as _os
+
+        prevs = sorted(glob.glob(
+            _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                          "BENCH_r*.json")
+        ))
+        if prevs:
+            with open(prevs[-1]) as f:
+                prev = json.load(f)
+            # The driver wraps the bench line under "parsed" (alongside
+            # n/cmd/rc/tail); accept both the wrapped and bare layouts.
+            prev = prev.get("parsed", prev)
+            vs_prev["prev_file"] = _os.path.basename(prevs[-1])
+
+            def delta(name, now_v, prev_v, higher_is_better):
+                if now_v is None or prev_v in (None, 0):
+                    return
+                r = now_v / prev_v
+                vs_prev[name] = {
+                    "prev": round(prev_v, 5), "now": round(now_v, 5),
+                    "ratio": round(r, 3),
+                    "regressed": bool(
+                        r < 0.95 if higher_is_better else r > 1.05
+                    ),
+                }
+
+            delta("headline_rounds_per_s", value, prev.get("value"), True)
+            delta("per_dispatch_rounds_per_s", per_dispatch,
+                  prev.get("per_dispatch_value"), True)
+            delta("compute_bound_fwd_grad_s", compute.get("fwd_grad_s"),
+                  (prev.get("compute_bound") or {}).get("fwd_grad_s"), False)
+            delta("fused_fwd_grad_s", fused.get("fwd_grad_s"),
+                  (prev.get("fused") or {}).get("fwd_grad_s"), False)
+            delta("dense20q_fwd_grad_s", dense20.get("fwd_grad_s"),
+                  (prev.get("dense20q") or {}).get("fwd_grad_s"), False)
+    except Exception as e:  # noqa: BLE001 — tracking must never kill bench
+        vs_prev["error"] = f"{type(e).__name__}: {e}"
     print(
         json.dumps(
             {
@@ -477,8 +555,12 @@ def main():
                 "fused": fused,
                 "compute_bound_bf16": compute_bf16,
                 "fused_bf16": fused_bf16,
+                "dense18q": dense18,
+                "dense18q_bf16": dense18_bf16,
                 "dense20q": dense20,
+                "dense20q_bf16": dense20_bf16,
                 "time_to_target": ttt,
+                "vs_prev": vs_prev,
             }
         )
     )

@@ -1,10 +1,15 @@
-"""Measure the fused whole-circuit kernel vs the per-gate XLA path on TPU.
+"""Measure the fused whole-circuit kernel vs the XLA slab engine on TPU.
 
 Usage: python benchmarks/fused_sweep.py [n_qubits ...]
+       python benchmarks/fused_sweep.py --encoding reupload [n_qubits ...]
 Prints one JSON line per config: fwd+grad seconds per step for the
-default XLA path and QFEDX_FUSED=1 (whole-circuit kernel), with the
-speedup. This is the data behind the fused routing default
-(ops.fused_hea.AUTO_MIN_QUBITS).
+default XLA path (the r04 slab engine, QFEDX_FUSED unset/0) and
+QFEDX_FUSED=1 (whole-circuit Pallas kernel), with the speedup. This is
+the data behind the r04 routing decision (ops.fused_hea.fused_enabled:
+auto routing to the kernel DISABLED — the slab engine measured faster
+at every width, both encodings; docs/PERF.md §4). The reupload rows
+answer VERDICT r03 item 2: config 4's circuit (~2× the gates/layer of
+plain HEA) measured on its own kernel rather than assumed.
 """
 
 from __future__ import annotations
@@ -20,14 +25,26 @@ import numpy as np
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
 
 
-def build_step(n_qubits, n_layers, batch, steps=8):
+def _enable_cache(jax):
+    try:
+        cache = str(Path(__file__).resolve().parent.parent / ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
+
+
+def build_step(n_qubits, n_layers, batch, steps=8, encoding="angle"):
     import jax
     import jax.numpy as jnp
     import optax
 
     from qfedx_tpu.models.vqc import make_vqc_classifier
 
-    model = make_vqc_classifier(n_qubits=n_qubits, n_layers=n_layers, num_classes=2)
+    _enable_cache(jax)
+    model = make_vqc_classifier(
+        n_qubits=n_qubits, n_layers=n_layers, num_classes=2, encoding=encoding
+    )
     params = model.init(jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.uniform(0, 1, (batch, n_qubits)), dtype=jnp.float32)
@@ -49,10 +66,10 @@ def build_step(n_qubits, n_layers, batch, steps=8):
     return many_steps, params, steps
 
 
-def timeit(n_qubits, n_layers=3, batch=64, reps=5):
+def timeit(n_qubits, n_layers=3, batch=64, reps=5, encoding="angle"):
     import jax
 
-    fn, params, steps = build_step(n_qubits, n_layers, batch)
+    fn, params, steps = build_step(n_qubits, n_layers, batch, encoding=encoding)
     _, ls = fn(params)
     jax.block_until_ready(ls)
 
@@ -86,26 +103,52 @@ def with_env(var, val, fn, *a):
 
 
 def main():
-    qubits = [int(a) for a in sys.argv[1:]] or [12, 14, 16, 18]
+    args = sys.argv[1:]
+    encoding = "angle"
+    if "--encoding" in args:
+        i = args.index("--encoding")
+        if i + 1 >= len(args) or args[i + 1].startswith("-"):
+            sys.exit("usage: fused_sweep.py [--encoding angle|reupload] "
+                     "[--bf16] [n_qubits ...]")
+        encoding = args[i + 1]
+        args = args[:i] + args[i + 2 :]
+    with_bf16 = "--bf16" in args
+    if with_bf16:
+        args.remove("--bf16")
+    qubits = [int(a) for a in args] or [10, 12, 13, 14, 16]
+    from qfedx_tpu.ops.fused_hea import fused_eligible
+
     for n in qubits:
-        row = {"n_qubits": n, "n_layers": 3, "batch": 64}
+        row = {
+            "n_qubits": n, "n_layers": 3, "batch": 64, "encoding": encoding
+        }
+        t = lambda m: timeit(m, encoding=encoding)  # noqa: E731
         try:
-            row["xla_s"] = round(with_env("QFEDX_FUSED", "0", timeit, n), 5)
-            row["fused_s"] = round(with_env("QFEDX_FUSED", "1", timeit, n), 5)
+            row["xla_s"] = round(with_env("QFEDX_FUSED", "0", t, n), 5)
+            if not fused_eligible(n):
+                # QFEDX_FUSED=1 is a no-op outside 8 ≤ n ≤ 16: timing the
+                # "fused" config would just re-measure the XLA path and
+                # record a fabricated ~1.0× parity row.
+                row["fused_s"] = None
+                row["note"] = "n outside fused-eligible range; XLA only"
+                print(json.dumps(row), flush=True)
+                continue
+            row["fused_s"] = round(with_env("QFEDX_FUSED", "1", t, n), 5)
             row["fused_speedup_vs_xla"] = round(row["xla_s"] / row["fused_s"], 3)
-            row["fused_bf16_s"] = round(
-                with_env("QFEDX_DTYPE", "bf16",
-                         lambda m: with_env("QFEDX_FUSED", "1", timeit, m), n),
-                5,
-            )
-            row["xla_bf16_s"] = round(
-                with_env("QFEDX_DTYPE", "bf16",
-                         lambda m: with_env("QFEDX_FUSED", "0", timeit, m), n),
-                5,
-            )
-            row["fused_bf16_speedup_vs_xla_f32"] = round(
-                row["xla_s"] / row["fused_bf16_s"], 3
-            )
+            if with_bf16:
+                row["fused_bf16_s"] = round(
+                    with_env("QFEDX_DTYPE", "bf16",
+                             lambda m: with_env("QFEDX_FUSED", "1", t, m), n),
+                    5,
+                )
+                row["xla_bf16_s"] = round(
+                    with_env("QFEDX_DTYPE", "bf16",
+                             lambda m: with_env("QFEDX_FUSED", "0", t, m), n),
+                    5,
+                )
+                row["fused_bf16_speedup_vs_xla_f32"] = round(
+                    row["xla_s"] / row["fused_bf16_s"], 3
+                )
             if os.environ.get("QFEDX_FUSED_BB"):
                 row["bb"] = int(os.environ["QFEDX_FUSED_BB"])
         except Exception as e:  # noqa: BLE001 — report per-config

@@ -1,0 +1,207 @@
+"""Profile the n=16 fwd+grad training step on the real chip.
+
+VERDICT r03 item 1: the bf16 null result (1.00x on dense despite halved
+HBM bytes) falsified the "HBM-bound" model and est_flop_util sits at
+0.69% — so the time is going somewhere no analytic byte count predicts.
+This script measures instead of estimating:
+
+  1. reproduces the bench timing (XLA dense + fused paths, n=16);
+  2. captures a ``jax.profiler.trace`` of each;
+  3. parses the trace protobuf/json and prints a per-op time breakdown.
+
+Run:  python benchmarks/profile_step.py [--trace-dir /tmp/qfedx-prof]
+Findings land in docs/PERF.md (written by hand from this output).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _enable_cache(jax):
+    try:
+        cache = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache",
+        )
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def build_step(n_qubits=16, n_layers=3, batch=64, steps=8):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    model = make_vqc_classifier(
+        n_qubits=n_qubits, n_layers=n_layers, num_classes=2
+    )
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 1, (batch, n_qubits)), dtype=jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, (batch,)), dtype=jnp.int32)
+
+    def loss(p):
+        logits = model.apply(p, x)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    @jax.jit
+    def many_steps(params):
+        def body(p, _):
+            l, g = jax.value_and_grad(loss)(p)
+            p2 = jax.tree.map(lambda a, b: a - 1e-6 * b, p, g)
+            return p2, l
+
+        return jax.lax.scan(body, params, None, length=steps)
+
+    return many_steps, params
+
+
+def timed(jax, fn, params, steps, reps=5):
+    _, ls = fn(params)
+    jax.block_until_ready(ls)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _, ls = fn(params)
+        jax.block_until_ready(ls)
+        times.append(time.perf_counter() - t0)
+    t = sorted(times)[len(times) // 2] / steps
+    if t < 1e-3:  # tunnel zero-timing artifact guard
+        return timed(jax, fn, params, steps, reps)
+    return t
+
+
+def parse_trace(trace_dir):
+    """Aggregate device-op durations from the newest trace.json.gz."""
+    paths = sorted(
+        glob.glob(
+            os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+        ),
+        key=os.path.getmtime,
+    )
+    if not paths:
+        return None, None
+    with gzip.open(paths[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # Identify device-side process/thread ids (TPU op track).
+    proc_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            proc_names[e["pid"]] = e["args"].get("name", "")
+    device_pids = {
+        pid
+        for pid, name in proc_names.items()
+        if "TPU" in name or "/device" in name.lower() or "Chip" in name
+    }
+    by_op = defaultdict(float)
+    total = 0.0
+    spans = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        if device_pids and e.get("pid") not in device_pids:
+            continue
+        dur = e.get("dur", 0) / 1e6  # us -> s
+        name = e.get("name", "?")
+        by_op[name] += dur
+        total += dur
+        spans.append((e.get("ts", 0), dur, name))
+    return by_op, {"total_s": total, "n_events": len(spans), "file": paths[-1],
+                   "proc_names": proc_names}
+
+
+def group_ops(by_op):
+    """Bucket XLA op names into readable categories."""
+    buckets = defaultdict(float)
+    for name, t in by_op.items():
+        low = name.lower()
+        if "fusion" in low:
+            key = "fusion"
+        elif "dot" in low or "convolution" in low:
+            key = "dot/conv"
+        elif "transpose" in low or "copy" in low:
+            key = "transpose/copy"
+        elif "reduce" in low:
+            key = "reduce"
+        elif "dynamic" in low:
+            key = "dynamic-slice/update"
+        elif "custom" in low or "mosaic" in low or "tpu_custom_call" in low:
+            key = "pallas-kernel"
+        else:
+            key = "other"
+        buckets[key] += t
+    return buckets
+
+
+def run_one(tag, env, trace_dir, args):
+    """Time + trace one configuration in a subprocess-free way via env."""
+    import jax
+
+    t = None
+    fn, params = build_step(args.n, args.layers, args.batch, args.steps)
+    t = timed(jax, fn, params, args.steps)
+    print(f"[{tag}] fwd+grad per step: {t*1e3:.2f} ms")
+    tdir = os.path.join(trace_dir, tag)
+    os.makedirs(tdir, exist_ok=True)
+    with jax.profiler.trace(tdir):
+        for _ in range(2):
+            _, ls = fn(params)
+            jax.block_until_ready(ls)
+    by_op, meta = parse_trace(tdir)
+    if by_op is None:
+        print(f"[{tag}] no trace file produced under {tdir}")
+        return t, None
+    print(f"[{tag}] trace: {meta['n_events']} device events, "
+          f"{meta['total_s']*1e3:.1f} ms total device time "
+          f"({meta['file']})")
+    buckets = group_ops(by_op)
+    for k, v in sorted(buckets.items(), key=lambda kv: -kv[1]):
+        print(f"  {k:24s} {v*1e3:9.2f} ms  ({100*v/meta['total_s']:5.1f}%)")
+    print(f"[{tag}] top 15 ops:")
+    for name, v in sorted(by_op.items(), key=lambda kv: -kv[1])[:15]:
+        print(f"  {v*1e3:9.2f} ms  {name[:110]}")
+    return t, by_op
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace-dir", default="/tmp/qfedx-prof")
+    ap.add_argument("--n", type=int, default=16)
+    ap.add_argument("--layers", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--mode", choices=["xla", "fused", "both"], default="both")
+    args = ap.parse_args()
+
+    import jax
+
+    _enable_cache(jax)
+    print(f"devices: {jax.devices()}")
+
+    if args.mode in ("xla", "both"):
+        os.environ["QFEDX_FUSED"] = "0"
+        run_one("xla", {}, args.trace_dir, args)
+    if args.mode in ("fused", "both"):
+        os.environ["QFEDX_FUSED"] = "1"
+        # fresh model cell → re-routes to fused
+        run_one("fused", {}, args.trace_dir, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from qfedx_tpu.ops import gates
-from qfedx_tpu.ops.statevector import apply_gate, apply_gate_2q, product_state
+from qfedx_tpu.ops.statevector import apply_cnot, apply_gate, product_state
 from qfedx_tpu.circuits.encoders import angle_amplitudes
 
 
@@ -41,9 +41,9 @@ def _entangle_ring(state: jnp.ndarray, n_qubits: int) -> jnp.ndarray:
     if n_qubits < 2:
         return state
     for q in range(n_qubits - 1):
-        state = apply_gate_2q(state, gates.CNOT, q, q + 1)
+        state = apply_cnot(state, q, q + 1)
     if n_qubits > 2:
-        state = apply_gate_2q(state, gates.CNOT, n_qubits - 1, 0)
+        state = apply_cnot(state, n_qubits - 1, 0)
     return state
 
 

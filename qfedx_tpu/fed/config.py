@@ -26,7 +26,9 @@ class DPConfig:
       4): clip every *example's* gradient to C inside each local step and
       noise the per-batch mean (fed.client per-example grad). Protects
       example membership; the accountant composes one step per LOCAL
-      step at q ≈ client_fraction · batch/|client dataset|.
+      step at q = batch/S_pad (padded client partition size), with client
+      sampling conservatively treated as amplification-FREE — client
+      fraction is deliberately NOT folded into q (run.trainer).
     """
 
     clip_norm: float = 1.0
@@ -64,7 +66,9 @@ class FedConfig:
     secure_agg_mode: str = "ring"
     secure_agg_neighbors: int = 1  # ring hops k; unmasking needs 2k colluders
     # Under DP, clients are weighted uniformly (sample-count weights would
-    # leak dataset sizes through the sensitivity analysis).
+    # leak dataset sizes through the sensitivity analysis). Setting this
+    # False with dp configured is rejected — the privacy guarantee must
+    # not hinge on a config default (see __post_init__).
     dp_uniform_weights: bool = True
 
     def __post_init__(self):
@@ -87,3 +91,12 @@ class FedConfig:
             # to clip — the DP-SGD sensitivity analysis doesn't apply.
             raise ValueError("per-example DP (dp mode='example') requires a "
                              "gradient optimizer (sgd/adam), not spsa")
+        if self.dp is not None and not self.dp_uniform_weights:
+            # Sample-count aggregation weights under DP leak each client's
+            # private dataset size into the aggregate and break the noise
+            # calibration both DP modes assume (uniform per-client share).
+            raise ValueError(
+                "dp requires dp_uniform_weights=True: sample-count "
+                "weighting leaks dataset sizes and invalidates the DP "
+                "noise calibration"
+            )

@@ -89,9 +89,11 @@ def make_fed_round(
                 # mode == "example": the update is already private (per-
                 # example clip+noise inside local steps, fed.client);
                 # clipping it again here would break the DP-SGD noise
-                # calibration. Weights stay uniform under either mode —
-                # sample-count weighting leaks dataset sizes.
-                weight = jnp.minimum(n, 1.0) if cfg.dp_uniform_weights else n
+                # calibration. Weights are ALWAYS uniform under DP —
+                # sample-count weighting would leak private dataset sizes
+                # and skew the calibrated per-client noise share
+                # (FedConfig rejects dp_uniform_weights=False with DP).
+                weight = jnp.minimum(n, 1.0)
             else:
                 weight = n
             weight = weight * part[cid]

@@ -26,15 +26,20 @@ RDTYPE = jnp.float32
 
 
 def state_dtype():
-    """dtype of statevector slabs: QFEDX_DTYPE=bf16 halves HBM traffic.
+    """dtype of statevector slabs: QFEDX_DTYPE=bf16 halves state bytes.
 
-    Gate application is ~1 FLOP/byte — HBM-bound on any accelerator — so
-    moving fewer bytes is the dominant lever in the dense regime
-    (BENCH_r02: ~60% HBM utilization at f32). Under bf16 the *states*
-    carry bf16 while parameters, gate construction (cos/sin of f32
-    angles, cast at apply time), and every reduction/readout accumulate
-    in f32 (``jnp.sum(..., dtype=f32)``), the bf16-state/f32-accumulate
-    recipe. Read at trace time; f32 is the default."""
+    What that buys depends on where the engine actually spends time —
+    measured per width on v5e (docs/PERF.md, BENCH_r03/r04). At n ≤ 16
+    the dense path is NOT byte-streaming-bound (the r03 "HBM-bound,
+    halve the bytes" story was falsified by a 1.00× bf16 result; the
+    time was relayout copies, since removed by the slab engine), so bf16
+    buys little there. At n = 18–20, where each gate pass genuinely
+    streams a multi-MB state, bf16 measures ~1.4× (n=18 fwd+grad 98 ms
+    vs 137 ms f32). Under bf16 the *states* carry bf16 while parameters,
+    gate construction (cos/sin of f32 angles, cast at apply time), and
+    every reduction/readout accumulate in f32 (``jnp.sum(...,
+    dtype=f32)``), the bf16-state/f32-accumulate recipe. Read at trace
+    time; f32 is the default."""
     return (
         jnp.bfloat16
         if os.environ.get("QFEDX_DTYPE", "float32") in ("bf16", "bfloat16")

@@ -31,12 +31,20 @@ Scope: the angle-encoded (real product state) hardware-efficient circuit
 of models.vqc — encoder → L × [rot_zx per qubit + CNOT ring] → ⟨Z_k⟩ —
 with 8 ≤ n ≤ 16 (n ≥ 8 so a full 128-lane dim exists; above 16 the
 Mosaic compile time becomes impractical — see MAX_QUBITS). Everything
-else falls back to the per-gate engine. Routing: `fused_enabled()`
-(QFEDX_FUSED=1 forces on, =0 forces off; unset → on-TPU auto for
-n ≥ AUTO_MIN_QUBITS, the measured-win regime). v5e measurements (batch
-64, 3 layers, fwd+grad; benchmarks/fused_sweep.json): 1.50× vs the XLA
-path at n=16, 1.27× at 14 (1.58×/1.35× with QFEDX_DTYPE=bf16), 0.89×
-at 12 (dispatch-bound — the XLA path keeps it).
+else falls back to the per-gate engine.
+
+STATUS (r04): **opt-in, no longer the default anywhere.** In round 4 the
+XLA dense engine adopted this kernel's own row/lane slab layout
+(ops/statevector.py `_SLAB_MIN`), and the XLA path now wins at every
+width: n=16 fwd+grad 26.3 ms vs 42.4 ms fused (v5e, batch 64, 3 layers
+— benchmarks/fused_sweep.json). A per-step profile (docs/PERF.md) shows
+why: the hand-written adjoint backward kernel runs ~24 ms of a 26.8 ms
+fused step — the uncompute sweep is VPU-serial, while XLA's autodiff of
+the slab forward schedules the same work better. Routing:
+`fused_enabled()` — QFEDX_FUSED=1 forces the kernel on (for eligible n),
+anything else uses the XLA slab engine. The kernel is kept as the
+measured-against alternative and as the template the slab engine's
+layout came from.
 """
 
 from __future__ import annotations
@@ -54,30 +62,39 @@ LANE_QUBITS = 7  # 2^7 = 128
 MIN_QUBITS = 8
 # 17–18 qubits fit the raised VMEM budget on paper but their Mosaic
 # compiles run tens of minutes (unrolled per-qubit program × state size)
-# — not shippable today; the sv-sharded engine covers that regime.
+# — not shippable today. Since r04 the question is moot: the XLA slab
+# engine (ops/statevector.py) serves every n ≥ 17 faster than this
+# kernel serves n = 16, with ordinary XLA compile times (n=18 ~50 s,
+# n=20 measured in docs/PERF.md).
 MAX_QUBITS = 16
-# Auto-route threshold, set from v5e measurement (fwd+grad, batch 64, 3
-# layers; benchmarks/fused_sweep.py, after the round-3 readout/λ-seed
-# matmul restructure): n=12 → 0.89× vs XLA (dispatch-bound, fused
-# loses), n=14 → 1.27×, n=15 → 1.38×, n=16 → 1.50× (1.35×/1.36×/1.58×
-# with bf16) and growing with n as the XLA path goes HBM-bound and its
-# autodiff tape approaches HBM capacity. Below the threshold
-# QFEDX_FUSED=1 still forces the path.
+# r03 auto-route threshold, kept for the historical record: against the
+# r03 tensordot XLA engine the kernel won at n ≥ 14 (1.27× @14, 1.50×
+# @16). Against the r04 slab XLA engine it loses at every width (n=16:
+# 0.62×), so AUTO ROUTING IS DISABLED — `fused_enabled()` returns True
+# only under explicit QFEDX_FUSED=1 (see benchmarks/fused_sweep.json).
 AUTO_MIN_QUBITS = 14
 
 _INTERPRET = False  # flipped by tests on CPU
 # Trace-time flag (set by the host wrappers while tracing a kernel whose
-# HBM slabs are bf16, unless QFEDX_MXU_BF16=0): lane-qubit matmuls then
-# run the MXU in bf16 with f32 accumulation — 4× the f32 MXU rate — while
+# HBM slabs are bf16 AND QFEDX_MXU_BF16=1): lane-qubit matmuls then run
+# the MXU in bf16 with f32 accumulation — 4× the f32 MXU rate — while
 # VPU row-gate arithmetic stays f32. Re-rounding the state at each lane
 # gate roughly doubles bf16-mode gradient error (≈10% vs ≈5% boundary-only
-# on the 8q test config, tests/test_bf16.py) — measured as acceptable for
-# convergence; set QFEDX_MXU_BF16=0 to keep bf16 at the HBM boundary only.
+# on the 8q test config, tests/test_bf16.py) for a measured ~4% speed
+# gain, so it is opt-in (see _mxu_bf16_enabled). Mutated as a global
+# around each pallas_call trace (try/finally in _fwd_call/_hea_bwd and
+# the reupload twins): tracing is synchronous and this runtime is
+# single-threaded, and with the kernel itself opt-in since r04 a full
+# re-thread of the helper-chain signatures isn't worth the churn.
 _MXU_BF16 = False
 
 
 def _mxu_bf16_enabled(slabs_bf16: bool) -> bool:
-    return slabs_bf16 and os.environ.get("QFEDX_MXU_BF16", "1") != "0"
+    # Default OFF since r04: bf16 lane matmuls roughly double bf16-mode
+    # gradient error (≈10% vs ≈5%, tests/test_bf16.py) for a measured
+    # ~4% speed gain (BENCH_r03 fused_bf16) — the wrong trade as a
+    # default. QFEDX_MXU_BF16=1 opts in.
+    return slabs_bf16 and os.environ.get("QFEDX_MXU_BF16", "0") == "1"
 
 
 # --------------------------------------------------------------------------
@@ -942,23 +959,10 @@ def fused_eligible(n_qubits: int) -> bool:
 
 
 def fused_enabled(n_qubits: int) -> bool:
-    """QFEDX_FUSED=1 forces on (for eligible n), =0 forces off; unset →
-    auto: on for TPU backends at n ≥ AUTO_MIN_QUBITS, where the per-gate
-    path is HBM-bound and fusion pays; small circuits are dispatch-bound
-    and stay on the (also known-real-optimized) XLA path."""
-    if not fused_eligible(n_qubits):
-        return False
-    flag = os.environ.get("QFEDX_FUSED")
-    if flag == "1":
-        return True
-    if flag == "0":
-        return False
-    if n_qubits < AUTO_MIN_QUBITS:
-        return False
-    # NOTE: jax.devices() initializes the backend — callers (models.vqc)
-    # defer this probe to first Model.apply, where a backend is needed
-    # anyway, so the auto-route never pins the platform early.
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:  # noqa: BLE001 — unusable backend: stay on XLA path
-        return False
+    """QFEDX_FUSED=1 forces the kernel on (for eligible n); anything else
+    routes to the XLA slab engine. Auto routing was retired in r04: the
+    slab engine (ops/statevector.py) measured faster than this kernel at
+    every eligible width on v5e (n=16: 26.3 ms vs 42.4 ms fwd+grad —
+    benchmarks/fused_sweep.json, docs/PERF.md), so there is no
+    measured-win regime left to auto-route to."""
+    return fused_eligible(n_qubits) and os.environ.get("QFEDX_FUSED") == "1"

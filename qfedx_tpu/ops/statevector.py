@@ -6,10 +6,18 @@ src/QFed/qAmplitude.py:44-46). Design (SURVEY.md §7.1.1):
 
 - State = ``CArray`` (re, im float32 pair — TPU has no complex dtype; see
   ops.cpx) of shape ``(2,)*n``; qubit k is axis k.
-- Gates contract onto target axes with ``jnp.tensordot`` — XLA lowers these
-  to batched matmuls on the MXU and fuses adjacent elementwise work. A
-  complex gate application is ≤4 real contractions; known-real gates/states
-  skip the missing parts at trace time.
+- Gates are applied WITHOUT contractions: a 2×2 gate is a broadcast
+  multiply by its diagonal plus a multiply of the axis-reversed state by
+  its off-diagonal (``_apply_ax``) — reverse/select/multiply/add chains
+  that XLA fuses into single passes over the state. The r03 tensordot
+  engine spent 53% of device time in the materialized transposes and
+  relayout copies contractions force (profiler evidence in docs/PERF.md);
+  this formulation removes them.
+- States with n ≥ ``_SLAB_MIN`` qubits additionally route through the
+  (R, 128) slab layout: row-qubit gates stay elementwise on leading axes,
+  lane-qubit gates become (R,128)×(128,128) structured matmuls on the MXU
+  — the TPU-native split (same as the fused Pallas kernel's), which also
+  removes the old high-rank XLA compile wall (n=20 compiles in minutes).
 - Batching over samples is ``jax.vmap``; everything is jit-compatible with
   static circuit structure (qubit indices are Python ints at trace time).
 - Gradients flow through the simulation with ``jax.grad`` (the framework's
@@ -23,6 +31,7 @@ dense statevector at 20 qubits — sharding is how we reach that and beyond).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from qfedx_tpu.ops.cpx import CArray, cabs2, state_dtype, vdot
@@ -67,43 +76,276 @@ def product_state(amps: CArray) -> CArray:
     return _creshape(state, (2,) * n) if n >= _FLAT_RANK else state
 
 
-def _contract_move(g: jnp.ndarray, s: jnp.ndarray, axes, src, dst) -> jnp.ndarray:
-    return jnp.moveaxis(jnp.tensordot(g, s, axes=axes), src, dst)
-
-
-def _apply(gate: CArray, state: CArray, axes, src, dst) -> CArray:
-    """out = G·ψ with the four real-contraction cases resolved at trace time.
-
-    Gates are built in f32 from f32 angles and cast here to the state's
+def _cast_gate(gate: CArray, state: CArray) -> CArray:
+    """Gates are built in f32 from f32 angles and cast to the state's
     dtype (bf16 under QFEDX_DTYPE=bf16) so mixed-dtype promotion never
     silently upcasts the state; parameter gradients flow back through the
     cast to f32."""
-    if gate.re.dtype != state.re.dtype:
-        gate = CArray(
-            gate.re.astype(state.re.dtype),
-            None if gate.im is None else gate.im.astype(state.re.dtype),
-        )
-    rr = _contract_move(gate.re, state.re, axes, src, dst)
-    if gate.im is None and state.im is None:
-        return CArray(rr, None)
-    if gate.im is None:
-        return CArray(rr, _contract_move(gate.re, state.im, axes, src, dst))
-    if state.im is None:
-        return CArray(rr, _contract_move(gate.im, state.re, axes, src, dst))
+    if gate.re.dtype == state.re.dtype:
+        return gate
     return CArray(
-        rr - _contract_move(gate.im, state.im, axes, src, dst),
-        _contract_move(gate.re, state.im, axes, src, dst)
-        + _contract_move(gate.im, state.re, axes, src, dst),
+        gate.re.astype(state.re.dtype),
+        None if gate.im is None else gate.im.astype(state.re.dtype),
+    )
+
+
+def _bshape(n: int, axis: int) -> tuple:
+    """Broadcast shape placing a length-2 coefficient on ``axis`` of rank n."""
+    return (1,) * axis + (2,) + (1,) * (n - axis - 1)
+
+
+def _apply_ax(gate: CArray, state: CArray, axis: int) -> CArray:
+    """out = G·ψ on one axis, as a single-pass elementwise program.
+
+    out[..i..] = U[i,i]·s[..i..] + U[i,1−i]·s[..1−i..] — i.e. a broadcast
+    multiply by the gate diagonal plus a multiply of the axis-reversed
+    state by the (swapped) off-diagonal. No ``tensordot``, no
+    ``moveaxis``: a profiler trace of the former contraction engine
+    (docs/PERF.md, r04) showed 53% of device time in materialized
+    transpose/relayout copies those ops force; reverse + multiply + add
+    fuse into ONE XLA pass over the state (~1 HBM round trip per gate).
+    The four real-component cases resolve at trace time (cpx.CArray)."""
+    gate = _cast_gate(gate, state)
+    n = state.ndim
+    shp = _bshape(n, axis)
+    idx = jnp.arange(2)
+    # diag [u00, u11] on the output bit; offdiag [u01, u10] multiplies the
+    # bit-flipped state.
+    ud_re = gate.re[idx, idx].reshape(shp)
+    uo_re = gate.re[idx, 1 - idx].reshape(shp)
+
+    def lin(ud, uo, s, f):
+        return ud * s + uo * f
+
+    f_re = jnp.flip(state.re, axis)
+    if gate.im is None and state.im is None:
+        return CArray(lin(ud_re, uo_re, state.re, f_re), None)
+    if gate.im is None:
+        f_im = jnp.flip(state.im, axis)
+        return CArray(
+            lin(ud_re, uo_re, state.re, f_re),
+            lin(ud_re, uo_re, state.im, f_im),
+        )
+    ud_im = gate.im[idx, idx].reshape(shp)
+    uo_im = gate.im[idx, 1 - idx].reshape(shp)
+    if state.im is None:
+        return CArray(
+            lin(ud_re, uo_re, state.re, f_re),
+            lin(ud_im, uo_im, state.re, f_re),
+        )
+    f_im = jnp.flip(state.im, axis)
+    return CArray(
+        lin(ud_re, uo_re, state.re, f_re) - lin(ud_im, uo_im, state.im, f_im),
+        lin(ud_re, uo_re, state.im, f_im) + lin(ud_im, uo_im, state.re, f_re),
+    )
+
+
+def _coeffs_2q(part: jnp.ndarray):
+    """The four (2,2) flip-combination coefficient grids of a real
+    (2,2,2,2) gate part: C_{dj,dk}[i,l] = G[i, l, i^dj, l^dk], so that
+    G·ψ = Σ_d C_d ⊙ flip_d(ψ) with flips over the two target axes."""
+    i, l = jnp.meshgrid(jnp.arange(2), jnp.arange(2), indexing="ij")
+    return [
+        part[i, l, i ^ dj, l ^ dk] for dj, dk in ((0, 0), (0, 1), (1, 0), (1, 1))
+    ]
+
+
+def _apply_ax_2q(gate: CArray, state: CArray, ax1: int, ax2: int) -> CArray:
+    """General two-qubit gate on axes (ax1, ax2) in flip/broadcast form —
+    same single-pass rationale as ``_apply_ax``; four flip terms."""
+    gate = _cast_gate(gate, state)
+    n = state.ndim
+    shp = (
+        tuple(2 if a in (ax1, ax2) else 1 for a in range(n))
+    )
+
+    def grids(part):
+        # C_{dj,dk}[i, l]: i lives on ax1, l on ax2. reshape maps the grid's
+        # first index onto the earlier axis, so transpose when ax1 > ax2.
+        cs = _coeffs_2q(part)
+        if ax1 > ax2:
+            cs = [c.T for c in cs]
+        return [c.reshape(shp) for c in cs]
+
+    def flips(s):
+        f2 = jnp.flip(s, ax2)
+        f1 = jnp.flip(s, ax1)
+        return s, f2, f1, jnp.flip(f1, ax2)
+
+    def lin(cs, fs):
+        return cs[0] * fs[0] + cs[1] * fs[1] + cs[2] * fs[2] + cs[3] * fs[3]
+
+    re_c = grids(gate.re)
+    fs_re = flips(state.re)
+    if gate.im is None and state.im is None:
+        return CArray(lin(re_c, fs_re), None)
+    if gate.im is None:
+        fs_im = flips(state.im)
+        return CArray(lin(re_c, fs_re), lin(re_c, fs_im))
+    im_c = grids(gate.im)
+    if state.im is None:
+        return CArray(lin(re_c, fs_re), lin(im_c, fs_re))
+    fs_im = flips(state.im)
+    return CArray(
+        lin(re_c, fs_re) - lin(im_c, fs_im),
+        lin(re_c, fs_im) + lin(im_c, fs_re),
     )
 
 
 # Above this rank the (2,)*n tensor form hits an XLA compile wall: layout
 # assignment and op lowering cost grow badly with tensor rank (measured on
 # the v5e toolchain: n=16 compiles in ~30s, n≥18 ran >20 minutes without
-# finishing). High-rank states therefore contract through rank-3/rank-5
-# reshaped VIEWS (row-major bit split around the target axes — pure
-# reshapes, free at the XLA level), keeping every dot at small rank.
+# finishing). High-rank states therefore contract through low-rank
+# reshaped VIEWS (row-major bit splits — pure reshapes at the XLA level),
+# keeping every op at small rank.
 _FLAT_RANK = 15
+
+# --------------------------------------------------------------------------
+# Slab layout: states with n ≥ _SLAB_MIN qubits are operated on as
+# (R, 128) = (2^{n-7}, 2^7) row-major views — the native TPU vector shape
+# (minor dim = one full lane register). Qubits n−7…n−1 live in the lane
+# dim, qubits 0…n−8 in the row dim (same split as the fused Pallas kernel,
+# ops/fused_hea.py). Why: a profiler trace of the r03 engine (docs/PERF.md)
+# showed 53% of device time in materialized transposes/relayout copies from
+# rank-n contractions, and reverses along minor axes run ~10× below HBM
+# peak. In slab form:
+#   - ROW-qubit gates flip/select along LEADING axes of a (a,2,c,128) view
+#     — contiguous c·128-sized chunks, fused by XLA into one elementwise
+#     pass over the state;
+#   - LANE-qubit gates are (R,128)×(128,128) matmuls against small
+#     structured matrices built from iota bit masks — they ride the MXU
+#     and never permute the layout.
+# Every view keeps the minor dim at 128, so the per-gate reshapes are
+# layout-preserving and adjacent reshape pairs cancel in XLA's simplifier.
+# This also caps program rank at ~6, which is what lets n ≥ 18 compile
+# (the old rank-3/5 _FLAT_RANK views solved compile time but not the
+# relayout traffic).
+_SLAB_MIN = 10
+_LANES = 128
+_LANE_BITS = 7
+
+
+def _slab_pos(n: int, qubit: int) -> int:
+    """Lane-bit position of qubit (valid when qubit ≥ n−7): qubit n−1 is
+    lane bit 0 (row-major flat index, axis 0 = MSB)."""
+    return n - 1 - qubit
+
+
+def _row_split(n: int, qubit: int) -> tuple:
+    """(a, 2, c, 128) view dims splitting the row index at ``qubit``."""
+    rbits = n - _LANE_BITS
+    return (1 << qubit, 2, 1 << (rbits - qubit - 1), _LANES)
+
+
+def _lane_iota():
+    j = jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANES), 0)
+    l = jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANES), 1)
+    return j, l
+
+
+def _lane_mt(part: jnp.ndarray, p: int) -> jnp.ndarray:
+    """(128,128) Mt with (s @ Mt) applying the 2×2 ``part`` on lane bit p:
+    Mt[j,l] = part[bit_l(p), bit_j(p)] where all other bits of j,l agree."""
+    j, l = _lane_iota()
+    other_ok = ((j ^ l) & (_LANES - 1 - (1 << p))) == 0
+    bj = (j >> p) & 1
+    bl = (l >> p) & 1
+    val = jnp.where(
+        bl == 0,
+        jnp.where(bj == 0, part[0, 0], part[0, 1]),
+        jnp.where(bj == 0, part[1, 0], part[1, 1]),
+    )
+    return jnp.where(other_ok, val, jnp.zeros((), dtype=part.dtype))
+
+
+def _lane_perm_flip(p: int, dtype) -> jnp.ndarray:
+    """(128,128) symmetric permutation: lane l ← lane l ^ (1<<p)."""
+    j, l = _lane_iota()
+    return (j == (l ^ (1 << p))).astype(dtype)
+
+
+def _lane_perm_cnot(pc: int, pt: int, dtype) -> jnp.ndarray:
+    """(128,128) Mt for CNOT with control lane-bit pc, target pt."""
+    j, l = _lane_iota()
+    tgt = jnp.where(((j >> pc) & 1) == 1, j ^ (1 << pt), j)
+    return (l == tgt).astype(dtype)
+
+
+def _matmul_lane(state: CArray, mt_re, mt_im) -> CArray:
+    """s @ Mt with complex parts resolved at trace time (MXU path)."""
+    rr = state.re @ mt_re
+    if mt_im is None and state.im is None:
+        return CArray(rr, None)
+    if mt_im is None:
+        return CArray(rr, state.im @ mt_re)
+    if state.im is None:
+        return CArray(rr, state.re @ mt_im)
+    return CArray(
+        rr - state.im @ mt_im, state.im @ mt_re + state.re @ mt_im
+    )
+
+
+def _slab_gate(state: CArray, gate: CArray, qubit: int) -> CArray:
+    """1-qubit gate on an n ≥ _SLAB_MIN state via the slab layout."""
+    n = state.ndim
+    shape = state.shape
+    gate = _cast_gate(gate, state)
+    if qubit >= n - _LANE_BITS:  # lane qubit → MXU matmul
+        flat = _creshape(state, (1 << (n - _LANE_BITS), _LANES))
+        p = _slab_pos(n, qubit)
+        mt_re = _lane_mt(gate.re, p)
+        mt_im = None if gate.im is None else _lane_mt(gate.im, p)
+        return _creshape(_matmul_lane(flat, mt_re, mt_im), shape)
+    view = _creshape(state, _row_split(n, qubit))
+    return _creshape(_apply_ax(gate, view, 1), shape)
+
+
+def _slab_cnot(state: CArray, ctrl: int, tgt: int) -> CArray:
+    """CNOT on an n ≥ _SLAB_MIN state: four row/lane cases, no relayouts."""
+    n = state.ndim
+    shape = state.shape
+    dt = state.re.dtype
+    row_limit = n - _LANE_BITS
+    c_row, t_row = ctrl < row_limit, tgt < row_limit
+    if c_row and t_row:
+        lo, hi = (ctrl, tgt) if ctrl < tgt else (tgt, ctrl)
+        a = 1 << lo
+        m = 1 << (hi - lo - 1)
+        c = 1 << (row_limit - hi - 1)
+        view = _creshape(state, (a, 2, m, 2, c, _LANES))
+        ax_c, ax_t = (1, 3) if ctrl < tgt else (3, 1)
+        return _creshape(_cnot_ax(view, ax_c, ax_t), shape)
+    if not c_row and not t_row:
+        flat = _creshape(state, (1 << row_limit, _LANES))
+        mt = _lane_perm_cnot(_slab_pos(n, ctrl), _slab_pos(n, tgt), dt)
+        return _creshape(_matmul_lane(flat, mt, None), shape)
+    if c_row:  # control in rows, target in lanes: select(rows, s@P, s)
+        view = _creshape(state, _row_split(n, ctrl))
+        mask = (
+            jnp.arange(2, dtype=jnp.int32).reshape(_bshape(4, 1)) == 1
+        )
+        p = _lane_perm_flip(_slab_pos(n, tgt), dt)
+
+        def one(s):
+            return jnp.where(mask, s @ p, s)
+
+        out = CArray(
+            one(view.re), None if view.im is None else one(view.im)
+        )
+        return _creshape(out, shape)
+    # control in lanes, target in rows: pure elementwise lane mask + flip
+    view = _creshape(state, _row_split(n, tgt))
+    lane_bit = (
+        jax.lax.broadcasted_iota(jnp.int32, (_LANES,), 0)
+        >> _slab_pos(n, ctrl)
+    ) & 1
+    mask = (lane_bit == 1).reshape(1, 1, 1, _LANES)
+
+    def one(s):
+        return jnp.where(mask, jnp.flip(s, 1), s)
+
+    out = CArray(one(view.re), None if view.im is None else one(view.im))
+    return _creshape(out, shape)
 
 
 def _creshape(c: CArray, shape) -> CArray:
@@ -114,14 +356,19 @@ def _creshape(c: CArray, shape) -> CArray:
 
 def apply_gate(state: CArray, gate: CArray, qubit: int) -> CArray:
     """Apply a (2,2) gate to axis ``qubit`` of a (2,)*n state."""
-    n = state.ndim
-    if n >= _FLAT_RANK:
-        shape = state.shape
-        a, c = 1 << qubit, 1 << (n - qubit - 1)
-        flat = _creshape(state, (a, 2, c))
-        out = _apply(gate, flat, ((1,), (1,)), 0, 1)
-        return _creshape(out, shape)
-    return _apply(gate, state, ((1,), (qubit,)), 0, qubit)
+    if state.ndim >= _SLAB_MIN:
+        return _slab_gate(state, gate, qubit)
+    return _apply_ax(gate, state, qubit)
+
+
+def _flat_2q(state: CArray, q1: int, q2: int):
+    """Rank-5 (a,2,m,2,c) view of a high-rank state around qubits q1,q2."""
+    lo, hi = (q1, q2) if q1 < q2 else (q2, q1)
+    a = 1 << lo
+    m = 1 << (hi - lo - 1)
+    c = 1 << (state.ndim - hi - 1)
+    ax1, ax2 = (1, 3) if q1 < q2 else (3, 1)
+    return _creshape(state, (a, 2, m, 2, c)), ax1, ax2
 
 
 def apply_gate_2q(state: CArray, gate: CArray, q1: int, q2: int) -> CArray:
@@ -129,15 +376,33 @@ def apply_gate_2q(state: CArray, gate: CArray, q1: int, q2: int) -> CArray:
     n = state.ndim
     if n >= _FLAT_RANK:
         shape = state.shape
-        lo, hi = (q1, q2) if q1 < q2 else (q2, q1)
-        a = 1 << lo
-        m = 1 << (hi - lo - 1)
-        c = 1 << (n - hi - 1)
-        flat = _creshape(state, (a, 2, m, 2, c))
-        ax1, ax2 = (1, 3) if q1 < q2 else (3, 1)
-        out = _apply(gate, flat, ((2, 3), (ax1, ax2)), (0, 1), (ax1, ax2))
-        return _creshape(out, shape)
-    return _apply(gate, state, ((2, 3), (q1, q2)), (0, 1), (q1, q2))
+        flat, ax1, ax2 = _flat_2q(state, q1, q2)
+        return _creshape(_apply_ax_2q(gate, flat, ax1, ax2), shape)
+    return _apply_ax_2q(gate, state, q1, q2)
+
+
+def apply_cnot(state: CArray, ctrl: int, tgt: int) -> CArray:
+    """CNOT as a masked select: out = where(bit_ctrl, flip_tgt(ψ), ψ).
+
+    A CNOT is a permutation, so the general four-term ``_apply_ax_2q``
+    wastes three multiplies per amplitude on zero coefficients; this is
+    one reverse + one select (or one permutation matmul in the slab lane
+    case), fully fusible — the entangler ring is half of all gates in the
+    hardware-efficient ansatz (circuits/ansatz.py), so the ring rides
+    this path."""
+    if state.ndim >= _SLAB_MIN:
+        return _slab_cnot(state, ctrl, tgt)
+    return _cnot_ax(state, ctrl, tgt)
+
+
+def _cnot_ax(state: CArray, ctrl_ax: int, tgt_ax: int) -> CArray:
+    n = state.ndim
+    mask = jnp.arange(2, dtype=jnp.int32).reshape(_bshape(n, ctrl_ax)) == 1
+
+    def one(s):
+        return jnp.where(mask, jnp.flip(s, tgt_ax), s)
+
+    return CArray(one(state.re), None if state.im is None else one(state.im))
 
 
 def probabilities(state: CArray) -> jnp.ndarray:
@@ -145,6 +410,28 @@ def probabilities(state: CArray) -> jnp.ndarray:
     and noise maps downstream need full precision regardless of the
     state dtype)."""
     return cabs2(state).reshape(-1).astype(jnp.float32)
+
+
+def _slab_z_all(probs: jnp.ndarray, n: int) -> jnp.ndarray:
+    """⟨Z_k⟩ ∀k from a probability tensor, slab style: reduce the slab to
+    (R,) row sums and (128,) lane sums — two passes over the state — then
+    take every per-qubit marginal from those small vectors."""
+    rbits = n - _LANE_BITS
+    slab = probs.reshape(1 << rbits, _LANES)
+    row_sums = jnp.sum(slab, axis=1, dtype=jnp.float32)  # (R,)
+    lane_sums = jnp.sum(slab, axis=0, dtype=jnp.float32)  # (128,)
+    out = []
+    for k in range(rbits):
+        a, c = 1 << k, 1 << (rbits - k - 1)
+        marg = jnp.sum(row_sums.reshape(a, 2, c), axis=(0, 2))
+        out.append(marg[0] - marg[1])
+    # lane qubits: z-sign per lane index, one (128,7) matmul for all
+    lane = jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANE_BITS), 0)
+    bitpos = (_LANE_BITS - 1) - jax.lax.broadcasted_iota(
+        jnp.int32, (_LANES, _LANE_BITS), 1
+    )  # qubit rbits+j ↔ lane bit 6−j
+    zmat = 1.0 - 2.0 * ((lane >> bitpos) & 1).astype(jnp.float32)
+    return jnp.concatenate([jnp.stack(out), lane_sums @ zmat])
 
 
 def expect_z(state: CArray, qubit: int) -> jnp.ndarray:
@@ -165,15 +452,9 @@ def expect_z_all(state: CArray) -> jnp.ndarray:
     """⟨Z_k⟩ for every qubit k at once, shape (n,), f32-accumulated."""
     probs = cabs2(state)
     n = probs.ndim
+    if n >= _SLAB_MIN:
+        return _slab_z_all(probs, n)
     out = []
-    if n >= _FLAT_RANK:  # rank-3 marginals (see _FLAT_RANK)
-        for k in range(n):
-            a, c = 1 << k, 1 << (n - k - 1)
-            marg = jnp.sum(
-                probs.reshape(a, 2, c), axis=(0, 2), dtype=jnp.float32
-            )
-            out.append(marg[0] - marg[1])
-        return jnp.stack(out)
     for k in range(n):
         axes = tuple(i for i in range(n) if i != k)
         marg = jnp.sum(probs, axis=axes, dtype=jnp.float32)

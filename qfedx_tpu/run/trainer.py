@@ -208,6 +208,16 @@ def train_federated(
             else min(len(test_x), eval_batches * 256)
         )
         repl = NamedSharding(mesh, P())
+        if cap < len(test_x):
+            import warnings
+
+            warnings.warn(
+                f"in-scan per-round eval uses the first {cap} of "
+                f"{len(test_x)} test samples (set eval_batches to raise "
+                "the cap); final reported accuracy is recomputed uncapped",
+                UserWarning,
+                stacklevel=2,
+            )
         ex_dev = jax.device_put(
             np.asarray(test_x[:cap], dtype=np.float32), repl
         )
@@ -324,12 +334,26 @@ def train_federated(
                 eps = accountant.epsilon(cfg.dp.delta)
                 result.epsilons.append(eps)
                 metrics["epsilon"] = eps
+                if r == start_round and cfg.dp.mode == "example":
+                    # Surface the accounting convention in the run record,
+                    # not only in a code comment: the Poisson-subsampled
+                    # RDP bound applied to a shuffle sampler at q=B/S_pad
+                    # is the Opacus/TF-privacy convention, not a strict
+                    # shuffle bound — reported ε can be optimistic.
+                    metrics["epsilon_accounting"] = (
+                        "poisson-rdp at q=B/S_pad on a shuffle sampler "
+                        "(Opacus/TF-privacy convention; not a strict "
+                        "shuffle bound)"
+                    )
             if scan_accs is not None:
                 # On-device eval came with the scanned dispatch: per-round
                 # accuracy at every round, no host round-trip, no
-                # eval_every trade-off.
+                # eval_every trade-off. eval_n records the (possibly
+                # capped) eval-set size so capped accuracies are
+                # identifiable in the JSONL.
                 result.accuracies.append(scan_accs[i])
                 metrics["accuracy"] = scan_accs[i]
+                metrics["eval_n"] = int(ex_dev.shape[0])
             elif (r + 1) % eval_every == 0 or r == num_rounds - 1:
                 eval_metrics = evaluate(params, test_x, test_y)
                 result.accuracies.append(eval_metrics["accuracy"])

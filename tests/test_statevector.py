@@ -226,38 +226,126 @@ def test_engine_jits_and_vmaps():
     np.testing.assert_allclose(np.asarray(out[0]), np.asarray(circuit(thetas[0])), atol=1e-6)
 
 
-def test_flat_rank_path_matches_tensor_path(monkeypatch):
-    """apply_gate/apply_gate_2q/expect_z_all via the rank-3/5 reshaped
-    views (_FLAT_RANK, the ≥15-qubit XLA-compile-wall workaround) must be
-    bit-compatible with the (2,)*n tensor form — forced here at small n by
-    lowering the threshold."""
+def test_flat_rank_2q_path_matches_tensor_path(monkeypatch):
+    """General apply_gate_2q via the rank-5 reshaped view (_FLAT_RANK,
+    the high-rank XLA-compile-wall workaround for non-CNOT 2q gates) must
+    match the (2,)*n tensor form — forced at small n by lowering the
+    threshold. Covers both qubit orders and a complex gate (CRZ)."""
+    import qfedx_tpu.ops.statevector as sv
+
+    n = 6
+    rng = np.random.default_rng(3)
+    state = from_complex(
+        (rng.normal(size=(2,) * n) + 1j * rng.normal(size=(2,) * n)).astype(
+            np.complex64
+        )
+    )
+    g = gates.crz(0.83)
+    for q1, q2 in ((1, 4), (4, 1), (0, 5)):
+        want = to_complex(apply_gate_2q(state, g, q1, q2))
+        monkeypatch.setattr(sv, "_FLAT_RANK", 1)
+        got = to_complex(sv.apply_gate_2q(state, g, q1, q2))
+        monkeypatch.setattr(sv, "_FLAT_RANK", 15)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+# --- slab engine (n ≥ _SLAB_MIN: row/lane layout) -------------------------
+#
+# The production path for 10–20-qubit states: row-qubit gates as
+# flip/select on leading axes, lane-qubit gates as (R,128)×(128,128)
+# structured matmuls, CNOT in four row/lane cases, two-pass ⟨Z⟩ readout.
+# n=10 (3 row bits, 7 lane bits) exercises every case against (a) numpy
+# complex ground truth and (b) the independently-tested low-rank flip
+# path with gradients.
+
+
+def test_slab_1q_gates_match_dense_oracle():
+    import qfedx_tpu.ops.statevector as sv
+
+    n = 10
+    assert n >= sv._SLAB_MIN  # the slab path is the one under test
+    v = rand_state(n)
+    state = as_cstate(v, n)
+    for gname, q in [
+        ("ry", 0), ("ry", 2), ("ry", 3), ("ry", 9),  # row + lane, real
+        ("rz", 1), ("rz", 5),                        # complex diag
+        ("rx", 2), ("rx", 7),                        # complex off-diag
+    ]:
+        g = gates.ROTATIONS[gname](0.6 + 0.1 * q)
+        got = to_complex(apply_gate(state, g, q)).reshape(-1)
+        want = dense_1q(gate_matrix(g), q, n) @ v
+        np.testing.assert_allclose(got, want, atol=1e-5)
+    # imag-only gate (Y) on a row and a lane qubit
+    for q in (1, 8):
+        got = to_complex(apply_gate(state, gates.Y, q)).reshape(-1)
+        want = dense_1q(gate_matrix(gates.Y), q, n) @ v
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_slab_cnot_all_four_cases_match_dense_oracle():
+    import qfedx_tpu.ops.statevector as sv
+    from qfedx_tpu.ops.statevector import apply_cnot
+
+    n = 10  # row bits: qubits 0-2, lane bits: qubits 3-9
+    assert n >= sv._SLAB_MIN
+    v = rand_state(n, seed=1)
+    state = as_cstate(v, n)
+    cases = [
+        (0, 1),  # row ctrl → row tgt
+        (2, 1),  # row-row, reversed order
+        (1, 6),  # row ctrl → lane tgt
+        (5, 2),  # lane ctrl → row tgt
+        (4, 8),  # lane-lane
+        (9, 3),  # lane-lane, reversed
+        (9, 0),  # the ring's wrap link: lane ctrl → row tgt
+    ]
+    for c, t in cases:
+        got = to_complex(apply_cnot(state, c, t)).reshape(-1)
+        want = _cnot_dense(c, t, n) @ v
+        np.testing.assert_allclose(got, want, atol=1e-5, err_msg=f"cnot {c}->{t}")
+
+
+def test_slab_expect_z_all_matches_dense_oracle():
+    import qfedx_tpu.ops.statevector as sv
+
+    n = 10
+    assert n >= sv._SLAB_MIN
+    v = rand_state(n, seed=2)
+    state = as_cstate(v, n)
+    got = np.asarray(sv.expect_z_all(state))
+    probs = np.abs(v) ** 2
+    idx = np.arange(2**n)
+    want = np.array(
+        [probs[(idx >> (n - 1 - q)) & 1 == 0].sum()
+         - probs[(idx >> (n - 1 - q)) & 1 == 1].sum() for q in range(n)]
+    )
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_slab_circuit_and_grads_match_low_rank_path(monkeypatch):
+    """Full HEA circuit (all four CNOT cases + complex rotations on row
+    and lane qubits) + readout + jax.grad: slab engine vs the low-rank
+    flip path, forced by moving _SLAB_MIN."""
     import qfedx_tpu.ops.statevector as sv
     from qfedx_tpu.circuits.ansatz import hardware_efficient, init_ansatz_params
     from qfedx_tpu.circuits.encoders import angle_encode
 
-    n = 5
+    n = 10
     params = init_ansatz_params(jax.random.PRNGKey(0), n, 2, scale=0.7)
     x = jnp.asarray(np.random.default_rng(0).uniform(0, 1, (n,)), jnp.float32)
 
-    def run():
-        state = hardware_efficient(angle_encode(x), params)
-        return sv.expect_z_all(state)
+    def loss(p):
+        state = hardware_efficient(angle_encode(x), p)
+        return jnp.sum(sv.expect_z_all(state) * jnp.arange(1.0, n + 1))
 
-    want = run()
-    monkeypatch.setattr(sv, "_FLAT_RANK", 1)
-    got = run()
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
-
-    def grads(fn):
-        def loss(p):
-            state = hardware_efficient(angle_encode(x), p)
-            return jnp.sum(sv.expect_z_all(state) * jnp.arange(1.0, n + 1))
-        return jax.grad(loss)(params)
-
-    g_flat = grads(run)
-    monkeypatch.setattr(sv, "_FLAT_RANK", 15)
-    g_tensor = grads(run)
-    for k in g_flat:
+    assert n >= sv._SLAB_MIN
+    want = loss(params)
+    g_slab = jax.grad(loss)(params)
+    monkeypatch.setattr(sv, "_SLAB_MIN", 99)  # force the low-rank path
+    got = loss(params)
+    g_low = jax.grad(loss)(params)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+    for k in g_slab:
         np.testing.assert_allclose(
-            np.asarray(g_flat[k]), np.asarray(g_tensor[k]), atol=1e-6
+            np.asarray(g_slab[k]), np.asarray(g_low[k]), atol=1e-4
         )
