@@ -39,19 +39,26 @@ import time
 import numpy as np
 
 
-def _enable_compile_cache(jax):
-    """Persistent compilation cache next to the repo: the fused-kernel
-    backward is a large Mosaic program (minutes to compile at 16q); the
-    cache makes every bench run after the first start hot."""
+def _bench_util():
+    """Import benchmarks._util, making sure the repo root is importable
+    even if bench.py is invoked from elsewhere (the driver's contract is
+    `python bench.py` at the repo root, but don't depend on it)."""
     import os
+    import sys as _sys
 
-    try:
-        cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".jax_cache")
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-    except Exception:  # noqa: BLE001 — cache is an optimization only
-        pass
+    root = os.path.dirname(os.path.abspath(__file__))
+    if root not in _sys.path:
+        _sys.path.insert(0, root)
+    from benchmarks import _util
+
+    return _util
+
+
+def _enable_compile_cache(jax):
+    """Persistent compilation cache next to the repo: the big XLA/Mosaic
+    programs take minutes to compile; the cache makes every bench run
+    after the first start hot (shared definition: benchmarks/_util.py)."""
+    _bench_util().enable_cache(jax)
 
 
 def _build():
@@ -107,15 +114,22 @@ def _time_spmd(jax, model, cfg, mesh, num_clients, data, make_fed_round,
     params, _ = round_fn(params, scx, scy, scm, key)
     params, _ = round_fn(params, scx, scy, scm, key)
     jax.block_until_ready(params)
-    times = []
-    for r in range(rounds):
-        key = jax.random.fold_in(key, r)
-        t0 = time.perf_counter()
-        params, _ = round_fn(params, scx, scy, scm, key)
-        jax.block_until_ready(params)
-        times.append(time.perf_counter() - t0)
-    # Median: robust to transient dispatch-latency spikes (tunneled TPU).
-    return sorted(times)[len(times) // 2]
+    def measure():
+        times = []
+        k = key
+        for r in range(rounds):
+            k = jax.random.fold_in(k, r)
+            t0 = time.perf_counter()
+            p, _ = round_fn(params, scx, scy, scm, k)
+            jax.block_until_ready(p)
+            times.append(time.perf_counter() - t0)
+        # Median: robust to transient dispatch-latency spikes.
+        return sorted(times)[len(times) // 2]
+
+    # ~0s tunnel artifact guard: a round through the tunnel cannot finish
+    # in <1 ms — BENCH_r04's first run recorded a bogus 73679 rounds/s
+    # per-dispatch figure without this.
+    return _bench_util().retry_timing(measure, label="per-dispatch round")
 
 
 def _time_spmd_scanned(jax, model, cfg, mesh, num_clients, data,
@@ -136,13 +150,20 @@ def _time_spmd_scanned(jax, model, cfg, mesh, num_clients, data,
     params, _ = rounds_fn(params, scx, scy, scm, base, 0)  # compile
     params, _ = rounds_fn(params, scx, scy, scm, base, 1)  # steady layout
     jax.block_until_ready(params)
-    times = []
-    for r in range(reps):
-        t0 = time.perf_counter()
-        params, _ = rounds_fn(params, scx, scy, scm, base, r)
-        jax.block_until_ready(params)
-        times.append(time.perf_counter() - t0)
-    return sorted(times)[len(times) // 2] / rounds_per_call
+    def measure():
+        times = []
+        for r in range(reps):
+            t0 = time.perf_counter()
+            p, _ = rounds_fn(params, scx, scy, scm, base, r)
+            jax.block_until_ready(p)
+            times.append(time.perf_counter() - t0)
+        return sorted(times)[len(times) // 2] / rounds_per_call
+
+    # ~0s tunnel artifact guard (see _time_spmd); floor scaled to the
+    # per-round quotient of one whole <1 ms dispatch.
+    return _bench_util().retry_timing(
+        measure, floor=1e-3 / rounds_per_call, label="scanned rounds"
+    )
 
 
 def _time_sequential(jax, model, cfg, num_clients, data, make_local_update,
@@ -284,12 +305,10 @@ def _bench_compute_bound(jax, n_qubits=16, n_layers=3, batch=64, reps=5,
             times.append(time.perf_counter() - t0)
         return sorted(times)[len(times) // 2] / steps
 
-    t = measure()
-    # Transient tunnel glitches have produced ~0s timings (a blocked-on
-    # value that was already resident); this workload cannot run in <1ms
-    # per step, so re-measure rather than record a bogus 1000× number.
-    if t < 1e-3:
-        t = measure()
+    # ~0s tunnel artifact guard (shared policy: benchmarks/_util.py).
+    t = _bench_util().retry_timing(
+        measure, floor=1e-3 / steps, label=f"dense n={n_qubits}"
+    )
 
     gates, fwd_flops, fwd_bytes = _dense_cost_model(
         n_qubits, n_layers, state_bytes

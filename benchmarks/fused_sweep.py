@@ -25,69 +25,15 @@ import numpy as np
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
 
 
-def _enable_cache(jax):
-    try:
-        cache = str(Path(__file__).resolve().parent.parent / ".jax_cache")
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-    except Exception:  # noqa: BLE001 — cache is an optimization only
-        pass
-
-
-def build_step(n_qubits, n_layers, batch, steps=8, encoding="angle"):
-    import jax
-    import jax.numpy as jnp
-    import optax
-
-    from qfedx_tpu.models.vqc import make_vqc_classifier
-
-    _enable_cache(jax)
-    model = make_vqc_classifier(
-        n_qubits=n_qubits, n_layers=n_layers, num_classes=2, encoding=encoding
-    )
-    params = model.init(jax.random.PRNGKey(0))
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.uniform(0, 1, (batch, n_qubits)), dtype=jnp.float32)
-    y = jnp.asarray(rng.integers(0, 2, (batch,)), dtype=jnp.int32)
-
-    def loss(p):
-        logits = model.apply(p, x)
-        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
-
-    @jax.jit
-    def many_steps(params):
-        def body(p, _):
-            l, g = jax.value_and_grad(loss)(p)
-            p2 = jax.tree.map(lambda a, b: a - 1e-6 * b, p, g)
-            return p2, l
-
-        return jax.lax.scan(body, params, None, length=steps)
-
-    return many_steps, params, steps
-
-
 def timeit(n_qubits, n_layers=3, batch=64, reps=5, encoding="angle"):
     import jax
 
-    fn, params, steps = build_step(n_qubits, n_layers, batch, encoding=encoding)
-    _, ls = fn(params)
-    jax.block_until_ready(ls)
+    from benchmarks._util import build_step, timed_median
 
-    def measure():
-        times = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            _, ls = fn(params)
-            jax.block_until_ready(ls)
-            times.append(time.perf_counter() - t0)
-        return sorted(times)[len(times) // 2] / steps
-
-    t = measure()
-    # Transient tunnel glitches have produced ~0s timings (see the same
-    # guard in bench.py); this workload cannot run in <1ms per step.
-    if t < 1e-3:
-        t = measure()
-    return t
+    fn, params, steps = build_step(
+        n_qubits, n_layers, batch, encoding=encoding
+    )
+    return timed_median(jax, fn, params, steps, reps, label=f"n={n_qubits}")
 
 
 def with_env(var, val, fn, *a):
@@ -115,6 +61,12 @@ def main():
     with_bf16 = "--bf16" in args
     if with_bf16:
         args.remove("--bf16")
+    xla_only = "--xla-only" in args
+    if xla_only:
+        # r04: the reupload Pallas kernel's Mosaic compile is SIGKILLed
+        # (OOM) by the tunnel's chipless AOT compile helper at every
+        # width tried — XLA-only rows are the honest obtainable data.
+        args.remove("--xla-only")
     qubits = [int(a) for a in args] or [10, 12, 13, 14, 16]
     from qfedx_tpu.ops.fused_hea import fused_eligible
 
@@ -125,6 +77,10 @@ def main():
         t = lambda m: timeit(m, encoding=encoding)  # noqa: E731
         try:
             row["xla_s"] = round(with_env("QFEDX_FUSED", "0", t, n), 5)
+            if xla_only:
+                row["note"] = "xla-only run (--xla-only)"
+                print(json.dumps(row), flush=True)
+                continue
             if not fused_eligible(n):
                 # QFEDX_FUSED=1 is a no-op outside 8 ≤ n ≤ 16: timing the
                 # "fused" config would just re-measure the XLA path and
@@ -134,7 +90,10 @@ def main():
                 print(json.dumps(row), flush=True)
                 continue
             row["fused_s"] = round(with_env("QFEDX_FUSED", "1", t, n), 5)
-            row["fused_speedup_vs_xla"] = round(row["xla_s"] / row["fused_s"], 3)
+            if row["fused_s"] > 0:
+                row["fused_speedup_vs_xla"] = round(
+                    row["xla_s"] / row["fused_s"], 3
+                )
             if with_bf16:
                 row["fused_bf16_s"] = round(
                     with_env("QFEDX_DTYPE", "bf16",

@@ -27,63 +27,7 @@ from collections import defaultdict
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _enable_cache(jax):
-    try:
-        cache = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            ".jax_cache",
-        )
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-    except Exception:  # noqa: BLE001
-        pass
-
-
-def build_step(n_qubits=16, n_layers=3, batch=64, steps=8):
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
-
-    from qfedx_tpu.models.vqc import make_vqc_classifier
-
-    model = make_vqc_classifier(
-        n_qubits=n_qubits, n_layers=n_layers, num_classes=2
-    )
-    params = model.init(jax.random.PRNGKey(0))
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.uniform(0, 1, (batch, n_qubits)), dtype=jnp.float32)
-    y = jnp.asarray(rng.integers(0, 2, (batch,)), dtype=jnp.int32)
-
-    def loss(p):
-        logits = model.apply(p, x)
-        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
-
-    @jax.jit
-    def many_steps(params):
-        def body(p, _):
-            l, g = jax.value_and_grad(loss)(p)
-            p2 = jax.tree.map(lambda a, b: a - 1e-6 * b, p, g)
-            return p2, l
-
-        return jax.lax.scan(body, params, None, length=steps)
-
-    return many_steps, params
-
-
-def timed(jax, fn, params, steps, reps=5):
-    _, ls = fn(params)
-    jax.block_until_ready(ls)
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        _, ls = fn(params)
-        jax.block_until_ready(ls)
-        times.append(time.perf_counter() - t0)
-    t = sorted(times)[len(times) // 2] / steps
-    if t < 1e-3:  # tunnel zero-timing artifact guard
-        return timed(jax, fn, params, steps, reps)
-    return t
+from benchmarks._util import build_step, enable_cache, timed_median
 
 
 def parse_trace(trace_dir):
@@ -111,18 +55,17 @@ def parse_trace(trace_dir):
     }
     by_op = defaultdict(float)
     total = 0.0
-    spans = []
+    n_events = 0
     for e in events:
         if e.get("ph") != "X":
             continue
         if device_pids and e.get("pid") not in device_pids:
             continue
         dur = e.get("dur", 0) / 1e6  # us -> s
-        name = e.get("name", "?")
-        by_op[name] += dur
+        by_op[e.get("name", "?")] += dur
         total += dur
-        spans.append((e.get("ts", 0), dur, name))
-    return by_op, {"total_s": total, "n_events": len(spans), "file": paths[-1],
+        n_events += 1
+    return by_op, {"total_s": total, "n_events": n_events, "file": paths[-1],
                    "proc_names": proc_names}
 
 
@@ -149,13 +92,15 @@ def group_ops(by_op):
     return buckets
 
 
-def run_one(tag, env, trace_dir, args):
-    """Time + trace one configuration in a subprocess-free way via env."""
+def run_one(tag, trace_dir, args):
+    """Time + trace one configuration (QFEDX_* env set by the caller
+    BEFORE the model is built — routing is read at build/trace time)."""
     import jax
 
-    t = None
-    fn, params = build_step(args.n, args.layers, args.batch, args.steps)
-    t = timed(jax, fn, params, args.steps)
+    fn, params, steps = build_step(
+        args.n, args.layers, args.batch, args.steps
+    )
+    t = timed_median(jax, fn, params, steps, label=f"n={args.n}")
     print(f"[{tag}] fwd+grad per step: {t*1e3:.2f} ms")
     tdir = os.path.join(trace_dir, tag)
     os.makedirs(tdir, exist_ok=True)
@@ -191,16 +136,16 @@ def main():
 
     import jax
 
-    _enable_cache(jax)
+    enable_cache(jax)
     print(f"devices: {jax.devices()}")
 
     if args.mode in ("xla", "both"):
         os.environ["QFEDX_FUSED"] = "0"
-        run_one("xla", {}, args.trace_dir, args)
+        run_one("xla", args.trace_dir, args)
     if args.mode in ("fused", "both"):
         os.environ["QFEDX_FUSED"] = "1"
         # fresh model cell → re-routes to fused
-        run_one("fused", {}, args.trace_dir, args)
+        run_one("fused", args.trace_dir, args)
 
 
 if __name__ == "__main__":

@@ -29,13 +29,12 @@ def state_dtype():
     """dtype of statevector slabs: QFEDX_DTYPE=bf16 halves state bytes.
 
     What that buys depends on where the engine actually spends time —
-    measured per width on v5e (docs/PERF.md, BENCH_r03/r04). At n ≤ 16
-    the dense path is NOT byte-streaming-bound (the r03 "HBM-bound,
-    halve the bytes" story was falsified by a 1.00× bf16 result; the
-    time was relayout copies, since removed by the slab engine), so bf16
-    buys little there. At n = 18–20, where each gate pass genuinely
-    streams a multi-MB state, bf16 measures ~1.4× (n=18 fwd+grad 98 ms
-    vs 137 ms f32). Under bf16 the *states* carry bf16 while parameters,
+    measured per width on v5e (docs/PERF.md §3, BENCH_r03/r04). On the
+    r03 contraction engine bf16 was a 1.00× null result at n=16 (the
+    time was relayout copies, not bytes). On the r04 slab engine, with
+    the copies gone, the same knob measures 1.43× at n=16, 1.12× at
+    n=18 and 1.87× at n=20 — the value of halving bytes tracks whatever
+    share of the step is genuinely streaming-bound. Under bf16 the *states* carry bf16 while parameters,
     gate construction (cos/sin of f32 angles, cast at apply time), and
     every reduction/readout accumulate in f32 (``jnp.sum(...,
     dtype=f32)``), the bf16-state/f32-accumulate recipe. Read at trace

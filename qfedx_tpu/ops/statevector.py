@@ -31,6 +31,8 @@ dense statevector at 20 qubits — sharding is how we reach that and beyond).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -92,6 +94,50 @@ def _cast_gate(gate: CArray, state: CArray) -> CArray:
 def _bshape(n: int, axis: int) -> tuple:
     """Broadcast shape placing a length-2 coefficient on ``axis`` of rank n."""
     return (1,) * axis + (2,) + (1,) * (n - axis - 1)
+
+
+def _gate_form() -> str:
+    """Which gate-application formulation to trace: "flip" (reverse/
+    select/broadcast chains + slab layout — the TPU production path,
+    docs/PERF.md §2) or "dot" (the r03 tensordot+moveaxis contractions).
+    The flip form is what makes TPU fast, but XLA's CPU backend compiles
+    reverse/select-heavy programs pathologically slowly (minutes for a
+    batch-256 4-qubit forward, measured r04 — the test suite went 21 min
+    → 90+ min), while the dot form compiles instantly there. So: flip on
+    TPU, dot on CPU; QFEDX_GATE_FORM pins either (the slab/flip parity
+    tests pin "flip" to keep the TPU path covered on CPU). Read at trace
+    time."""
+    env = os.environ.get("QFEDX_GATE_FORM")
+    if env in ("flip", "dot"):
+        return env
+    try:
+        return "flip" if jax.default_backend() == "tpu" else "dot"
+    except Exception:  # noqa: BLE001 — no backend yet: safe choice
+        return "dot"
+
+
+def _contract_move(g: jnp.ndarray, s: jnp.ndarray, axes, src, dst) -> jnp.ndarray:
+    return jnp.moveaxis(jnp.tensordot(g, s, axes=axes), src, dst)
+
+
+def _apply_dot(gate: CArray, state: CArray, axes, src, dst) -> CArray:
+    """out = G·ψ by tensor contraction (the "dot" gate form): four real
+    cases resolved at trace time. On TPU this form materializes a
+    transpose/relayout per gate (the r03 bottleneck); on CPU it is the
+    form XLA compiles well."""
+    gate = _cast_gate(gate, state)
+    rr = _contract_move(gate.re, state.re, axes, src, dst)
+    if gate.im is None and state.im is None:
+        return CArray(rr, None)
+    if gate.im is None:
+        return CArray(rr, _contract_move(gate.re, state.im, axes, src, dst))
+    if state.im is None:
+        return CArray(rr, _contract_move(gate.im, state.re, axes, src, dst))
+    return CArray(
+        rr - _contract_move(gate.im, state.im, axes, src, dst),
+        _contract_move(gate.re, state.im, axes, src, dst)
+        + _contract_move(gate.im, state.re, axes, src, dst),
+    )
 
 
 def _apply_ax(gate: CArray, state: CArray, axis: int) -> CArray:
@@ -225,6 +271,26 @@ _LANES = 128
 _LANE_BITS = 7
 
 
+def _lane_strategy() -> str:
+    """How lane-qubit (minor-dim) gates are applied: "matmul" = the
+    (R,128)×(128,128) structured-matrix form — layout-preserving and MXU-
+    friendly, THE point of the slab design on TPU — or "flip" = low-rank
+    (a,2,c) reshape views with reverse/select, the r03-style fallback.
+    The matmul form is ~128× the FLOPs of the 2×2 contraction it encodes;
+    on the MXU those FLOPs are free (docs/PERF.md §2), on a scalar CPU
+    backend they are very much not (the 8-device virtual test mesh slowed
+    ~4×), so CPU defaults to "flip". QFEDX_SLAB_LANES pins either choice
+    (the slab parity/bf16 tests pin "matmul" to cover the TPU path on
+    CPU). Read at trace time."""
+    env = os.environ.get("QFEDX_SLAB_LANES")
+    if env in ("matmul", "flip"):
+        return env
+    try:
+        return "matmul" if jax.default_backend() == "tpu" else "flip"
+    except Exception:  # noqa: BLE001 — no backend yet: cheap choice
+        return "flip"
+
+
 def _slab_pos(n: int, qubit: int) -> int:
     """Lane-bit position of qubit (valid when qubit ≥ n−7): qubit n−1 is
     lane bit 0 (row-major flat index, axis 0 = MSB)."""
@@ -290,7 +356,11 @@ def _slab_gate(state: CArray, gate: CArray, qubit: int) -> CArray:
     n = state.ndim
     shape = state.shape
     gate = _cast_gate(gate, state)
-    if qubit >= n - _LANE_BITS:  # lane qubit → MXU matmul
+    if qubit >= n - _LANE_BITS:  # lane qubit
+        if _lane_strategy() == "flip":  # CPU: low-rank reverse view
+            a, c = 1 << qubit, 1 << (n - qubit - 1)
+            flat = _creshape(state, (a, 2, c))
+            return _creshape(_apply_ax(gate, flat, 1), shape)
         flat = _creshape(state, (1 << (n - _LANE_BITS), _LANES))
         p = _slab_pos(n, qubit)
         mt_re = _lane_mt(gate.re, p)
@@ -307,6 +377,16 @@ def _slab_cnot(state: CArray, ctrl: int, tgt: int) -> CArray:
     dt = state.re.dtype
     row_limit = n - _LANE_BITS
     c_row, t_row = ctrl < row_limit, tgt < row_limit
+    if (not (c_row and t_row)) and _lane_strategy() == "flip":
+        # CPU fallback (see _lane_strategy): generic low-rank view +
+        # reverse/select instead of permutation matmuls.
+        lo, hi = (ctrl, tgt) if ctrl < tgt else (tgt, ctrl)
+        a = 1 << lo
+        m = 1 << (hi - lo - 1)
+        c = 1 << (n - hi - 1)
+        view = _creshape(state, (a, 2, m, 2, c))
+        ax_c, ax_t = (1, 3) if ctrl < tgt else (3, 1)
+        return _creshape(_cnot_ax(view, ax_c, ax_t), shape)
     if c_row and t_row:
         lo, hi = (ctrl, tgt) if ctrl < tgt else (tgt, ctrl)
         a = 1 << lo
@@ -356,7 +436,17 @@ def _creshape(c: CArray, shape) -> CArray:
 
 def apply_gate(state: CArray, gate: CArray, qubit: int) -> CArray:
     """Apply a (2,2) gate to axis ``qubit`` of a (2,)*n state."""
-    if state.ndim >= _SLAB_MIN:
+    n = state.ndim
+    if _gate_form() == "dot":
+        if n >= _FLAT_RANK:
+            shape = state.shape
+            a, c = 1 << qubit, 1 << (n - qubit - 1)
+            flat = _creshape(state, (a, 2, c))
+            return _creshape(
+                _apply_dot(gate, flat, ((1,), (1,)), 0, 1), shape
+            )
+        return _apply_dot(gate, state, ((1,), (qubit,)), 0, qubit)
+    if n >= _SLAB_MIN:
         return _slab_gate(state, gate, qubit)
     return _apply_ax(gate, state, qubit)
 
@@ -372,12 +462,22 @@ def _flat_2q(state: CArray, q1: int, q2: int):
 
 
 def apply_gate_2q(state: CArray, gate: CArray, q1: int, q2: int) -> CArray:
-    """Apply a (2,2,2,2) gate tensor G[o1,o2,i1,i2] to axes (q1, q2)."""
+    """Apply a (2,2,2,2) gate tensor G[o1,o2,i1,i2] to axes (q1, q2).
+
+    GENERAL 2q gates at slab widths use the rank-5 DOT view even in flip
+    mode: the four-term flip form reverses near-minor axes of a big
+    state — the exact strided-access pattern docs/PERF.md §2(a) measured
+    at ~10× below HBM peak — and there is no slab specialization for
+    arbitrary 4×4 tensors. CNOT (the only 2q gate in the hot paths) has
+    its own fast route in ``apply_cnot``."""
     n = state.ndim
-    if n >= _FLAT_RANK:
+    if n >= _FLAT_RANK or (n >= _SLAB_MIN and _gate_form() != "dot"):
         shape = state.shape
         flat, ax1, ax2 = _flat_2q(state, q1, q2)
-        return _creshape(_apply_ax_2q(gate, flat, ax1, ax2), shape)
+        out = _apply_dot(gate, flat, ((2, 3), (ax1, ax2)), (0, 1), (ax1, ax2))
+        return _creshape(out, shape)
+    if _gate_form() == "dot":
+        return _apply_dot(gate, state, ((2, 3), (q1, q2)), (0, 1), (q1, q2))
     return _apply_ax_2q(gate, state, q1, q2)
 
 
@@ -389,7 +489,12 @@ def apply_cnot(state: CArray, ctrl: int, tgt: int) -> CArray:
     one reverse + one select (or one permutation matmul in the slab lane
     case), fully fusible — the entangler ring is half of all gates in the
     hardware-efficient ansatz (circuits/ansatz.py), so the ring rides
-    this path."""
+    this path. In the "dot" gate form (CPU — see _gate_form) it falls
+    back to the general contraction with the CNOT tensor."""
+    if _gate_form() == "dot":
+        from qfedx_tpu.ops import gates as _g
+
+        return apply_gate_2q(state, _g.CNOT, ctrl, tgt)
     if state.ndim >= _SLAB_MIN:
         return _slab_cnot(state, ctrl, tgt)
     return _cnot_ax(state, ctrl, tgt)

@@ -1,5 +1,6 @@
 from qfedx_tpu.parallel.sharded import (  # noqa: F401
     ShardCtx,
+    apply_cnot_sharded,
     apply_gate_2q_sharded,
     apply_gate_sharded,
     expect_z_all_sharded,

@@ -21,7 +21,7 @@ from qfedx_tpu.parallel.sharded import (
     ShardCtx,
     amplitude_encode_local,
     apply_channel_all_sharded,
-    apply_gate_2q_sharded,
+    apply_cnot_sharded,
     apply_gate_sharded,
     expect_z_all_sharded,
     product_state_local,
@@ -66,9 +66,9 @@ def sharded_hea_state(
             )
         if n >= 2:
             for q in range(n - 1):
-                state = apply_gate_2q_sharded(ctx, state, gates.CNOT, q, q + 1)
+                state = apply_cnot_sharded(ctx, state, q, q + 1)
             if n > 2:
-                state = apply_gate_2q_sharded(ctx, state, gates.CNOT, n - 1, 0)
+                state = apply_cnot_sharded(ctx, state, n - 1, 0)
         for ci, kraus in enumerate(channels):
             state = apply_channel_all_sharded(
                 ctx, state, kraus, jax.random.fold_in(key, layer * 8 + ci)

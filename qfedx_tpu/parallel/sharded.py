@@ -282,11 +282,33 @@ def apply_gate_2q_sharded(
     gate shape supported at any width.
     """
     assert q1 != q2
+
+    def local_apply(s, a1, a2):
+        return sv.apply_gate_2q(s, gate, ctx.local_axis(a1), ctx.local_axis(a2))
+
+    return _sharded_2q(ctx, state, q1, q2, local_apply)
+
+
+def apply_cnot_sharded(ctx: ShardCtx, state: CArray, ctrl: int, tgt: int) -> CArray:
+    """CNOT with the same global/local choreography as
+    ``apply_gate_2q_sharded`` but the local application routed through
+    ``sv.apply_cnot`` — one reverse + select (or a permutation matmul in
+    the slab lane case) instead of the general 4×4 contraction. The
+    entangler ring is half the gates of the sharded VQC, so it matters
+    that the ring rides the fast path on the local shard too
+    (docs/PERF.md §2)."""
+    assert ctrl != tgt
+
+    def local_apply(s, a1, a2):
+        return sv.apply_cnot(s, ctx.local_axis(a1), ctx.local_axis(a2))
+
+    return _sharded_2q(ctx, state, ctrl, tgt, local_apply)
+
+
+def _sharded_2q(ctx: ShardCtx, state: CArray, q1: int, q2: int, local_apply):
     globals_ = [q for q in (q1, q2) if q < ctx.n_global]
     if not globals_:
-        return sv.apply_gate_2q(
-            state, gate, ctx.local_axis(q1), ctx.local_axis(q2)
-        )
+        return local_apply(state, q1, q2)
     if ctx.n_local < 2:
         raise ValueError("need ≥2 local qubits for sharded 2q gates")
     # Scratch local qubits not otherwise involved in the gate.
@@ -297,7 +319,7 @@ def apply_gate_2q_sharded(
         mapping[g] = scratch.pop()
         state = swap_global_local(ctx, state, g, mapping[g])
     a1, a2 = mapping.get(q1, q1), mapping.get(q2, q2)
-    state = sv.apply_gate_2q(state, gate, ctx.local_axis(a1), ctx.local_axis(a2))
+    state = local_apply(state, a1, a2)
     for g, l in reversed(list(mapping.items())):
         state = swap_global_local(ctx, state, g, l)
     return state

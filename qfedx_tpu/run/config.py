@@ -47,6 +47,10 @@ class ModelConfig:
     n_qubits: int = 8
     n_layers: int = 2
     encoding: str = "angle"  # angle | amplitude | reupload
+    # Ansatz init angle scale (small-angle near-identity start; see
+    # circuits.ansatz.init_ansatz_params). Exposed because DP-SGD cells
+    # are sensitive to the init draw's robustness under noise.
+    init_scale: float = 0.1
     # MPS bond dimension χ (model="mps"): the accuracy/cost knob of the
     # tensor-network simulator for n_qubits ≫ 20 (reference ROADMAP.md:86).
     bond_dim: int = 16
@@ -135,6 +139,7 @@ def build_model(cfg: ExperimentConfig, num_classes: int):
             n_layers=m.n_layers,
             num_classes=num_classes,
             bond_dim=m.bond_dim,
+            init_scale=m.init_scale,
         )
     if m.model == "qkernel":
         from qfedx_tpu.models.kernel import make_quantum_kernel_classifier
@@ -184,6 +189,7 @@ def build_model(cfg: ExperimentConfig, num_classes: int):
                 n_layers=m.n_layers,
                 num_classes=num_classes,
                 encoding=m.encoding,
+                init_scale=m.init_scale,
                 noise_model=noise_model,
             )
         return make_vqc_classifier(
@@ -191,6 +197,7 @@ def build_model(cfg: ExperimentConfig, num_classes: int):
             n_layers=m.n_layers,
             num_classes=num_classes,
             encoding=m.encoding,
+            init_scale=m.init_scale,
             noise_model=noise_model,
             remat=m.remat,
         )

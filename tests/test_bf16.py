@@ -97,12 +97,15 @@ def test_dense_gradient_error_bounded(bf16_env):
         assert np.linalg.norm(gb - gf) / denom < 0.12
 
 
-def test_slab_bf16_forward_and_gradient_error_bounded(bf16_env):
+def test_slab_bf16_forward_and_gradient_error_bounded(bf16_env, monkeypatch):
     """Same bounds on the slab engine (n=10 ≥ _SLAB_MIN): bf16 lane-qubit
     matmuls and slab flip/select passes must not add error beyond the
-    per-gate-rounding class measured on the low-rank path."""
+    per-gate-rounding class measured on the low-rank path. Pins the TPU
+    production configuration (flip gate form + matmul lanes) on CPU."""
     import qfedx_tpu.ops.statevector as sv
 
+    monkeypatch.setenv("QFEDX_GATE_FORM", "flip")
+    monkeypatch.setenv("QFEDX_SLAB_LANES", "matmul")
     n = 10
     assert n >= sv._SLAB_MIN
     rx, rz, x = _setup(n=n, batch=4, seed=3)

@@ -226,6 +226,35 @@ def test_engine_jits_and_vmaps():
     np.testing.assert_allclose(np.asarray(out[0]), np.asarray(circuit(thetas[0])), atol=1e-6)
 
 
+def test_flat_rank_1q_dot_path_matches_tensor_path(monkeypatch):
+    """1-qubit gates via the rank-3 reshaped dot view — the production
+    CPU path at n ≥ _FLAT_RANK in the "dot" gate form — must match the
+    (2,)*n tensordot form, values AND gradients, forced at small n by
+    lowering the threshold."""
+    import qfedx_tpu.ops.statevector as sv
+    from qfedx_tpu.circuits.ansatz import hardware_efficient, init_ansatz_params
+    from qfedx_tpu.circuits.encoders import angle_encode
+
+    monkeypatch.setenv("QFEDX_GATE_FORM", "dot")
+    n = 5
+    params = init_ansatz_params(jax.random.PRNGKey(0), n, 2, scale=0.7)
+    x = jnp.asarray(np.random.default_rng(0).uniform(0, 1, (n,)), jnp.float32)
+
+    def loss(p):
+        state = hardware_efficient(angle_encode(x), p)
+        return jnp.sum(sv.expect_z_all(state) * jnp.arange(1.0, n + 1))
+
+    want, g_tensor = loss(params), jax.grad(loss)(params)
+    monkeypatch.setattr(sv, "_FLAT_RANK", 1)
+    got, g_flat = loss(params), jax.grad(loss)(params)
+    monkeypatch.setattr(sv, "_FLAT_RANK", 15)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+    for k in g_flat:
+        np.testing.assert_allclose(
+            np.asarray(g_flat[k]), np.asarray(g_tensor[k]), atol=1e-5
+        )
+
+
 def test_flat_rank_2q_path_matches_tensor_path(monkeypatch):
     """General apply_gate_2q via the rank-5 reshaped view (_FLAT_RANK,
     the high-rank XLA-compile-wall workaround for non-CNOT 2q gates) must
@@ -256,10 +285,20 @@ def test_flat_rank_2q_path_matches_tensor_path(monkeypatch):
 # structured matmuls, CNOT in four row/lane cases, two-pass ⟨Z⟩ readout.
 # n=10 (3 row bits, 7 lane bits) exercises every case against (a) numpy
 # complex ground truth and (b) the independently-tested low-rank flip
-# path with gradients.
+# path with gradients. QFEDX_SLAB_LANES=matmul pins the TPU lane
+# strategy (CPU auto-selects the cheap "flip" form — _lane_strategy).
 
 
-def test_slab_1q_gates_match_dense_oracle():
+@pytest.fixture
+def slab_matmul_lanes(monkeypatch):
+    # Pin the full TPU production configuration on the CPU test backend:
+    # flip/slab gate form + MXU-style lane matmuls (see _gate_form /
+    # _lane_strategy — CPU auto-selects the cheap "dot"/"flip" forms).
+    monkeypatch.setenv("QFEDX_GATE_FORM", "flip")
+    monkeypatch.setenv("QFEDX_SLAB_LANES", "matmul")
+
+
+def test_slab_1q_gates_match_dense_oracle(slab_matmul_lanes):
     import qfedx_tpu.ops.statevector as sv
 
     n = 10
@@ -282,7 +321,7 @@ def test_slab_1q_gates_match_dense_oracle():
         np.testing.assert_allclose(got, want, atol=1e-5)
 
 
-def test_slab_cnot_all_four_cases_match_dense_oracle():
+def test_slab_cnot_all_four_cases_match_dense_oracle(slab_matmul_lanes):
     import qfedx_tpu.ops.statevector as sv
     from qfedx_tpu.ops.statevector import apply_cnot
 
@@ -305,7 +344,7 @@ def test_slab_cnot_all_four_cases_match_dense_oracle():
         np.testing.assert_allclose(got, want, atol=1e-5, err_msg=f"cnot {c}->{t}")
 
 
-def test_slab_expect_z_all_matches_dense_oracle():
+def test_slab_expect_z_all_matches_dense_oracle(slab_matmul_lanes):
     import qfedx_tpu.ops.statevector as sv
 
     n = 10
@@ -322,7 +361,7 @@ def test_slab_expect_z_all_matches_dense_oracle():
     np.testing.assert_allclose(got, want, atol=1e-5)
 
 
-def test_slab_circuit_and_grads_match_low_rank_path(monkeypatch):
+def test_slab_circuit_and_grads_match_low_rank_path(slab_matmul_lanes, monkeypatch):
     """Full HEA circuit (all four CNOT cases + complex rotations on row
     and lane qubits) + readout + jax.grad: slab engine vs the low-rank
     flip path, forced by moving _SLAB_MIN."""
