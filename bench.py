@@ -114,22 +114,28 @@ def _time_spmd(jax, model, cfg, mesh, num_clients, data, make_fed_round,
     params, _ = round_fn(params, scx, scy, scm, key)
     params, _ = round_fn(params, scx, scy, scm, key)
     jax.block_until_ready(params)
-    def measure():
-        times = []
-        k = key
-        for r in range(rounds):
-            k = jax.random.fold_in(k, r)
-            t0 = time.perf_counter()
-            p, _ = round_fn(params, scx, scy, scm, k)
-            jax.block_until_ready(p)
-            times.append(time.perf_counter() - t0)
-        # Median: robust to transient dispatch-latency spikes.
-        return sorted(times)[len(times) // 2]
+    # Chain params/keys through REAL training rounds and time the whole
+    # block: repeated dispatches with identical inputs measure ~0.1-0.4 ms
+    # through the tunnel (elided — BENCH_r04's first run recorded a bogus
+    # 73679 rounds/s from exactly that), and per-round medians of chained
+    # calls still catch pipelining undershoot. Wall-clock over a chained
+    # sequence divided by its length is the honest sequential-throughput
+    # number.
+    state = {"params": params, "key": key}
 
-    # ~0s tunnel artifact guard: a round through the tunnel cannot finish
-    # in <1 ms — BENCH_r04's first run recorded a bogus 73679 rounds/s
-    # per-dispatch figure without this.
-    return _bench_util().retry_timing(measure, label="per-dispatch round")
+    def measure():
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            state["key"] = jax.random.fold_in(state["key"], r)
+            state["params"], _ = round_fn(
+                state["params"], scx, scy, scm, state["key"]
+            )
+        jax.block_until_ready(state["params"])
+        return (time.perf_counter() - t0) / rounds
+
+    return _bench_util().retry_timing(
+        measure, floor=3e-4, label="per-dispatch round"
+    )
 
 
 def _time_spmd_scanned(jax, model, cfg, mesh, num_clients, data,
@@ -150,17 +156,21 @@ def _time_spmd_scanned(jax, model, cfg, mesh, num_clients, data,
     params, _ = rounds_fn(params, scx, scy, scm, base, 0)  # compile
     params, _ = rounds_fn(params, scx, scy, scm, base, 1)  # steady layout
     jax.block_until_ready(params)
+    # Chained across reps for the same reason as _time_spmd: identical
+    # repeated dispatches are elided by the tunnel and time as ~0 s.
+    state = {"params": params}
+
     def measure():
         times = []
         for r in range(reps):
             t0 = time.perf_counter()
-            p, _ = rounds_fn(params, scx, scy, scm, base, r)
-            jax.block_until_ready(p)
+            state["params"], _ = rounds_fn(
+                state["params"], scx, scy, scm, base, r
+            )
+            jax.block_until_ready(state["params"])
             times.append(time.perf_counter() - t0)
         return sorted(times)[len(times) // 2] / rounds_per_call
 
-    # ~0s tunnel artifact guard (see _time_spmd); floor scaled to the
-    # per-round quotient of one whole <1 ms dispatch.
     return _bench_util().retry_timing(
         measure, floor=1e-3 / rounds_per_call, label="scanned rounds"
     )
@@ -296,11 +306,15 @@ def _bench_compute_bound(jax, n_qubits=16, n_layers=3, batch=64, reps=5,
     p_out, ls = many_steps(params)  # compile
     jax.block_until_ready(ls)
 
+    # Chained across reps (identical repeated dispatches are elided by
+    # the tunnel and time as ~0 s — see _time_spmd).
+    state = {"params": params}
+
     def measure():
         times = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            p_out, ls = many_steps(params)
+            state["params"], ls = many_steps(state["params"])
             jax.block_until_ready(ls)
             times.append(time.perf_counter() - t0)
         return sorted(times)[len(times) // 2] / steps
@@ -570,6 +584,14 @@ def main():
                 ),
                 "rounds_per_call": scan_k,
                 "per_dispatch_value": round(per_dispatch, 3),
+                # The un-scanned number is tunnel-RTT-bound, not
+                # engine-bound: one 8q round's device time is ~3-8 ms
+                # while the measured per-dispatch round tracks the
+                # tunnel's round-trip latency, which varies 16-150 ms
+                # day to day (r03 vs r04 measurements). Compare engines
+                # on the scanned headline and the compute_bound rows.
+                "per_dispatch_note": "tunnel-RTT-bound; varies with "
+                "tunnel weather, not engine speed",
                 "compute_bound": compute,
                 "fused": fused,
                 "compute_bound_bf16": compute_bf16,

@@ -65,15 +65,19 @@ def retry_timing(measure, floor=1e-3, attempts=5, label=""):
 
 def timed_median(jax, fn, params, steps, reps=5, label=""):
     """Median seconds PER STEP over ``reps`` dispatches of a scanned
-    ``steps``-step program, artifact-guarded by ``retry_timing``."""
-    _, ls = fn(params)  # warm (compile)
+    ``steps``-step program, artifact-guarded by ``retry_timing``.
+    Chains fn's first output back in as the next input: repeated
+    dispatches with IDENTICAL inputs are elided by the tunnel and time
+    as ~0 s (measured r04 — see bench.py _time_spmd)."""
+    state = {"params": params}
+    state["params"], ls = fn(state["params"])  # warm (compile)
     jax.block_until_ready(ls)
 
     def measure():
         times = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            _, ls = fn(params)
+            state["params"], ls = fn(state["params"])
             jax.block_until_ready(ls)
             times.append(time.perf_counter() - t0)
         return sorted(times)[len(times) // 2] / steps

@@ -108,7 +108,13 @@ def _gate_form() -> str:
     tests pin "flip" to keep the TPU path covered on CPU). Read at trace
     time."""
     env = os.environ.get("QFEDX_GATE_FORM")
-    if env in ("flip", "dot"):
+    if env:
+        if env not in ("flip", "dot"):
+            # A typo here would silently measure/run the OTHER
+            # formulation — the wrong-path-measured error class.
+            raise ValueError(
+                f"QFEDX_GATE_FORM={env!r}: expected 'flip' or 'dot'"
+            )
         return env
     try:
         return "flip" if jax.default_backend() == "tpu" else "dot"
@@ -283,7 +289,11 @@ def _lane_strategy() -> str:
     (the slab parity/bf16 tests pin "matmul" to cover the TPU path on
     CPU). Read at trace time."""
     env = os.environ.get("QFEDX_SLAB_LANES")
-    if env in ("matmul", "flip"):
+    if env:
+        if env not in ("matmul", "flip"):
+            raise ValueError(
+                f"QFEDX_SLAB_LANES={env!r}: expected 'matmul' or 'flip'"
+            )
         return env
     try:
         return "matmul" if jax.default_backend() == "tpu" else "flip"

@@ -361,6 +361,33 @@ def test_slab_expect_z_all_matches_dense_oracle(slab_matmul_lanes):
     np.testing.assert_allclose(got, want, atol=1e-5)
 
 
+def test_slab_flip_lanes_matches_dense_oracle(monkeypatch):
+    """The flip-form slab engine with the "flip" LANE strategy (the
+    default for QFEDX_GATE_FORM=flip on a CPU backend — low-rank reverse
+    views instead of 128×128 matmuls) against the numpy oracle: 1q gates
+    on row+lane qubits and all four CNOT row/lane cases."""
+    import qfedx_tpu.ops.statevector as sv
+    from qfedx_tpu.ops.statevector import apply_cnot
+
+    monkeypatch.setenv("QFEDX_GATE_FORM", "flip")
+    monkeypatch.setenv("QFEDX_SLAB_LANES", "flip")
+    n = 10
+    assert n >= sv._SLAB_MIN
+    v = rand_state(n, seed=5)
+    state = as_cstate(v, n)
+    for gname, q in [("ry", 1), ("rz", 2), ("rx", 5), ("rz", 9)]:
+        g = gates.ROTATIONS[gname](0.4 + 0.2 * q)
+        got = to_complex(apply_gate(state, g, q)).reshape(-1)
+        want = dense_1q(gate_matrix(g), q, n) @ v
+        np.testing.assert_allclose(got, want, atol=1e-5)
+    for c, t in [(0, 1), (1, 6), (5, 2), (4, 8), (9, 0)]:
+        got = to_complex(apply_cnot(state, c, t)).reshape(-1)
+        want = _cnot_dense(c, t, n) @ v
+        np.testing.assert_allclose(
+            got, want, atol=1e-5, err_msg=f"cnot {c}->{t}"
+        )
+
+
 def test_slab_circuit_and_grads_match_low_rank_path(slab_matmul_lanes, monkeypatch):
     """Full HEA circuit (all four CNOT cases + complex rotations on row
     and lane qubits) + readout + jax.grad: slab engine vs the low-rank
