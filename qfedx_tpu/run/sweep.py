@@ -62,8 +62,18 @@ def preset_cells(preset: str) -> list[dict]:
         # comparable.
         cells = []
         bi = {"classes": (0, 1)}
+        # Width axis at rounds=16 × 2 local epochs (r04, measured): the
+        # shared 8-round budget under-trained the wider models and bent
+        # the accuracy-vs-qubits curve down at q=8 (0.769 mean, min
+        # 0.716); at this budget q8 reaches [0.915, 0.908, 0.981]. The
+        # three width cells share THIS config (internally comparable);
+        # the other axes keep their own 8-round baseline cells (q4-d2,
+        # q4-p1.0) so per-axis comparisons are unaffected.
         for q in (2, 4, 8):
-            cells.append(_cell(f"q{q}-iid", qubits=q, clients=8, **bi))
+            cells.append(
+                _cell(f"q{q}-iid", qubits=q, clients=8, rounds=16,
+                      local_epochs=2, **bi)
+            )
         # Depth axis (ROADMAP.md:105: "depth 1–3").
         for d in (1, 2, 3):
             cells.append(
