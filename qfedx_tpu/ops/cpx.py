@@ -32,9 +32,11 @@ def state_dtype():
     measured per width on v5e (docs/PERF.md §3, BENCH_r03/r04). On the
     r03 contraction engine bf16 was a 1.00× null result at n=16 (the
     time was relayout copies, not bytes). On the r04 slab engine, with
-    the copies gone, the same knob measures 1.43× at n=16, 1.12× at
-    n=18 and 1.87× at n=20 — the value of halving bytes tracks whatever
-    share of the step is genuinely streaming-bound. Under bf16 the *states* carry bf16 while parameters,
+    the copies gone, the same knob measures 1.0–1.43× at n=16 (run-to-
+    run noisy; the step is partly bubble-bound), 1.12× at n=18 and a
+    stable 1.8–1.9× at n=20 — the value of halving bytes tracks
+    whatever share of the step is genuinely streaming-bound.
+    Under bf16 the *states* carry bf16 while parameters,
     gate construction (cos/sin of f32 angles, cast at apply time), and
     every reduction/readout accumulate in f32 (``jnp.sum(...,
     dtype=f32)``), the bf16-state/f32-accumulate recipe. Read at trace
