@@ -115,10 +115,11 @@ def _time_spmd(jax, model, cfg, mesh, num_clients, data, make_fed_round,
     params, _ = round_fn(params, scx, scy, scm, key)
     jax.block_until_ready(params)
     # Chain params/keys through REAL training rounds and time the whole
-    # block: repeated dispatches with identical inputs measure ~0.1-0.4 ms
-    # through the tunnel (elided — BENCH_r04's first run recorded a bogus
-    # 73679 rounds/s from exactly that), and per-round medians of chained
-    # calls still catch pipelining undershoot. Wall-clock over a chained
+    # block, anchored by a host fetch: repeated dispatches with identical
+    # inputs are elided by the tunnel (~0.1-0.4 ms "rounds" — BENCH_r04's
+    # first run recorded a bogus 73679 rounds/s from exactly that), and
+    # block_until_ready alone can ack queued-but-unexecuted work
+    # (benchmarks/_util.device_sync). Wall-clock over a chained, fetched
     # sequence divided by its length is the honest sequential-throughput
     # number.
     state = {"params": params, "key": key}
@@ -130,7 +131,7 @@ def _time_spmd(jax, model, cfg, mesh, num_clients, data, make_fed_round,
             state["params"], _ = round_fn(
                 state["params"], scx, scy, scm, state["key"]
             )
-        jax.block_until_ready(state["params"])
+        _bench_util().device_sync(state["params"])
         return (time.perf_counter() - t0) / rounds
 
     return _bench_util().retry_timing(
@@ -142,7 +143,8 @@ def _time_spmd_scanned(jax, model, cfg, mesh, num_clients, data,
                        shard_client_data, rounds_per_call=10, reps=5):
     """The trainer's optimized path (--rounds-per-call): K rounds scanned
     inside one dispatch (fed.round.make_fed_rounds, bit-identical to
-    sequential rounds). Returns median seconds PER ROUND."""
+    sequential rounds). Returns seconds PER ROUND (median across chained
+    measurement blocks - benchmarks/_util.retry_timing)."""
     from qfedx_tpu.fed.round import make_fed_rounds
 
     cx, cy, cmask = data
@@ -156,20 +158,18 @@ def _time_spmd_scanned(jax, model, cfg, mesh, num_clients, data,
     params, _ = rounds_fn(params, scx, scy, scm, base, 0)  # compile
     params, _ = rounds_fn(params, scx, scy, scm, base, 1)  # steady layout
     jax.block_until_ready(params)
-    # Chained across reps for the same reason as _time_spmd: identical
-    # repeated dispatches are elided by the tunnel and time as ~0 s.
+    # Chained across reps + host-fetch anchored, for the same reasons as
+    # _time_spmd (dispatch elision; lying block_until_ready).
     state = {"params": params}
 
     def measure():
-        times = []
+        t0 = time.perf_counter()
         for r in range(reps):
-            t0 = time.perf_counter()
             state["params"], _ = rounds_fn(
                 state["params"], scx, scy, scm, base, r
             )
-            jax.block_until_ready(state["params"])
-            times.append(time.perf_counter() - t0)
-        return sorted(times)[len(times) // 2] / rounds_per_call
+        _bench_util().device_sync(state["params"])
+        return (time.perf_counter() - t0) / (reps * rounds_per_call)
 
     return _bench_util().retry_timing(
         measure, floor=1e-3 / rounds_per_call, label="scanned rounds"
@@ -306,18 +306,16 @@ def _bench_compute_bound(jax, n_qubits=16, n_layers=3, batch=64, reps=5,
     p_out, ls = many_steps(params)  # compile
     jax.block_until_ready(ls)
 
-    # Chained across reps (identical repeated dispatches are elided by
-    # the tunnel and time as ~0 s — see _time_spmd).
+    # Chained across reps + host-fetch anchored (dispatch elision and
+    # lying block_until_ready — see _time_spmd / _util.device_sync).
     state = {"params": params}
 
     def measure():
-        times = []
+        t0 = time.perf_counter()
         for _ in range(reps):
-            t0 = time.perf_counter()
             state["params"], ls = many_steps(state["params"])
-            jax.block_until_ready(ls)
-            times.append(time.perf_counter() - t0)
-        return sorted(times)[len(times) // 2] / steps
+        _bench_util().device_sync(ls)
+        return (time.perf_counter() - t0) / (reps * steps)
 
     # ~0s tunnel artifact guard (shared policy: benchmarks/_util.py).
     t = _bench_util().retry_timing(
@@ -572,6 +570,15 @@ def main():
                 "metric": "vqc_client_rounds_per_sec_per_chip",
                 "value": round(value, 3),
                 "unit": "client-rounds/s/chip",
+                # r04 onward: timing loops chain dispatches and anchor on
+                # a real host fetch (benchmarks/_util.device_sync) — the
+                # tunnel elides identical-input dispatches AND can ack
+                # readiness for unexecuted work. Cross-round comparisons
+                # against pre-r04 BENCH files mix methodologies (the old
+                # per-rep block method over-counted per-dispatch
+                # overhead; e.g. n=16 dense reads 16 ms now vs 26-28 ms
+                # measured the old way on the SAME engine).
+                "timing_methodology": "chained+fetch-anchored (r04)",
                 # Headline ratio compares the K-round scanned dispatch
                 # against the reference's sequential per-round architecture
                 # (dispatch amortization included, by design — both run the

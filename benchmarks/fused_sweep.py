@@ -30,7 +30,7 @@ def timeit(n_qubits, n_layers=3, batch=64, reps=5, encoding="angle"):
     fn, params, steps = build_step(
         n_qubits, n_layers, batch, encoding=encoding
     )
-    return timed_median(jax, fn, params, steps, reps, label=f"n={n_qubits}")
+    return timed_median(fn, params, steps, reps, label=f"n={n_qubits}")
 
 
 def with_env(var, val, fn, *a):

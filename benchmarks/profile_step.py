@@ -26,7 +26,12 @@ from collections import defaultdict
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-from benchmarks._util import build_step, enable_cache, timed_median
+from benchmarks._util import (
+    build_step,
+    device_sync,
+    enable_cache,
+    timed_median,
+)
 
 
 def parse_trace(trace_dir):
@@ -99,14 +104,18 @@ def run_one(tag, trace_dir, args):
     fn, params, steps = build_step(
         args.n, args.layers, args.batch, args.steps
     )
-    t = timed_median(jax, fn, params, steps, label=f"n={args.n}")
+    t = timed_median(fn, params, steps, label=f"n={args.n}")
     print(f"[{tag}] fwd+grad per step: {t*1e3:.2f} ms")
     tdir = os.path.join(trace_dir, tag)
     os.makedirs(tdir, exist_ok=True)
+    # Chain + fetch-anchor inside the trace too: identical-input
+    # re-dispatches are elided and bare block_until_ready can ack
+    # unexecuted work (docs/PERF.md §6) — either would leave the trace
+    # empty or partial.
     with jax.profiler.trace(tdir):
         for _ in range(2):
-            _, ls = fn(params)
-            jax.block_until_ready(ls)
+            params, ls = fn(params)
+        device_sync(params)
     by_op, meta = parse_trace(tdir)
     if by_op is None:
         print(f"[{tag}] no trace file produced under {tdir}")
