@@ -473,11 +473,11 @@ def main():
                 compute["fwd_grad_s"] / row["fwd_grad_s"], 3
             )
     # The 18–20-qubit dense frontier (reference ROADMAP.md:86), measured on
-    # the real chip: 18q batch 16 (fits without remat on the slab engine),
-    # 20q batch 8 with per-layer remat (the autodiff tape at 2^20 amps ×
-    # 120 gates would not fit HBM otherwise) — each in f32 AND bf16, the
-    # regime where byte-halving measurably pays (VERDICT r03 item 4;
-    # docs/PERF.md §3).
+    # the real chip: 18q batch 16, 20q batch 8 — both WITHOUT remat. The
+    # r04 per-layer remat at 20q was the whole performance cliff (XLA fused
+    # the recomputed forward into every angle-cotangent reduction: 311 ms →
+    # 64 ms f32 without it; docs/PERF.md §7). The real tape is ~60
+    # rotation-gate residuals ≈ 4 GB f32 at batch 8 — it fits.
     dense18 = safe(
         lambda j: _with_env(
             {"QFEDX_FUSED": "0"}, _bench_compute_bound, j,
@@ -493,13 +493,13 @@ def main():
     dense20 = safe(
         lambda j: _with_env(
             {"QFEDX_FUSED": "0"}, _bench_compute_bound, j,
-            20, 3, 8, 3, 4, True,
+            20, 3, 8, 3, 4, False,
         )
     )
     dense20_bf16 = safe(
         lambda j: _with_env(
             {"QFEDX_FUSED": "0", "QFEDX_DTYPE": "bf16"},
-            _bench_compute_bound, j, 20, 3, 8, 3, 4, True,
+            _bench_compute_bound, j, 20, 3, 8, 3, 4, False,
         )
     )
     for now, base in ((dense18_bf16, dense18), (dense20_bf16, dense20)):

@@ -6,7 +6,8 @@ import os
 import time
 
 
-def build_step(n_qubits, n_layers=3, batch=64, steps=8, encoding="angle"):
+def build_step(n_qubits, n_layers=3, batch=64, steps=8, encoding="angle",
+               remat=False):
     """The standard bench program: ``steps`` SGD fwd+grad steps on a VQC,
     scanned into ONE jitted dispatch (the ~100 ms tunnel dispatch latency
     would otherwise flatten every timing to the latency floor). Shared by
@@ -21,7 +22,8 @@ def build_step(n_qubits, n_layers=3, batch=64, steps=8, encoding="angle"):
 
     enable_cache(jax)
     model = make_vqc_classifier(
-        n_qubits=n_qubits, n_layers=n_layers, num_classes=2, encoding=encoding
+        n_qubits=n_qubits, n_layers=n_layers, num_classes=2, encoding=encoding,
+        remat=remat,
     )
     params = model.init(jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)

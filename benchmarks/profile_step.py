@@ -102,7 +102,7 @@ def run_one(tag, trace_dir, args):
     import jax
 
     fn, params, steps = build_step(
-        args.n, args.layers, args.batch, args.steps
+        args.n, args.layers, args.batch, args.steps, remat=args.remat
     )
     t = timed_median(fn, params, steps, label=f"n={args.n}")
     print(f"[{tag}] fwd+grad per step: {t*1e3:.2f} ms")
@@ -139,6 +139,10 @@ def main():
     ap.add_argument("--layers", type=int, default=3)
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--remat", action="store_true",
+                    help="per-layer jax.checkpoint (the retired r04 n=20 "
+                    "config — reproduces the cliff of docs/PERF.md §7; "
+                    "the shipped bench runs n=20 without remat)")
     ap.add_argument("--mode", choices=["xla", "fused", "both"], default="both")
     args = ap.parse_args()
 
