@@ -5,7 +5,7 @@ HBM bytes) falsified the "HBM-bound" model and est_flop_util sits at
 0.69% — so the time is going somewhere no analytic byte count predicts.
 This script measures instead of estimating:
 
-  1. reproduces the bench timing (XLA dense + fused paths, n=16);
+  1. reproduces the bench timing (dense slab path);
   2. captures a ``jax.profiler.trace`` of each;
   3. parses the trace protobuf/json and prints a per-op time breakdown.
 
@@ -143,7 +143,6 @@ def main():
                     help="per-layer jax.checkpoint (the retired r04 n=20 "
                     "config — reproduces the cliff of docs/PERF.md §7; "
                     "the shipped bench runs n=20 without remat)")
-    ap.add_argument("--mode", choices=["xla", "fused", "both"], default="both")
     args = ap.parse_args()
 
     import jax
@@ -151,13 +150,7 @@ def main():
     enable_cache(jax)
     print(f"devices: {jax.devices()}")
 
-    if args.mode in ("xla", "both"):
-        os.environ["QFEDX_FUSED"] = "0"
-        run_one("xla", args.trace_dir, args)
-    if args.mode in ("fused", "both"):
-        os.environ["QFEDX_FUSED"] = "1"
-        # fresh model cell → re-routes to fused
-        run_one("fused", args.trace_dir, args)
+    run_one("xla", args.trace_dir, args)
 
 
 if __name__ == "__main__":

@@ -81,6 +81,62 @@ def hardware_efficient(
     return state
 
 
+def ansatz_layer_b(state, n_qubits: int, rx_angles, rz_angles):
+    """Batched-slab twin of ``ansatz_layer``: same circuit, state shape
+    (B, 2^n) with batch folded into slab rows (ops.batched — the layout
+    fix for scanned-batch training; docs/PERF.md §8)."""
+    from qfedx_tpu.ops.batched import apply_cnot_b, apply_gate_b
+
+    for q in range(n_qubits):
+        state = apply_gate_b(
+            state, n_qubits, gates.rot_zx(rx_angles[q], rz_angles[q]), q
+        )
+    if n_qubits < 2:
+        return state
+    for q in range(n_qubits - 1):
+        state = apply_cnot_b(state, n_qubits, q, q + 1)
+    if n_qubits > 2:
+        state = apply_cnot_b(state, n_qubits, n_qubits - 1, 0)
+    return state
+
+
+def hardware_efficient_b(state, n_qubits: int, params: dict):
+    """Batched-slab twin of ``hardware_efficient`` (no remat variant: the
+    batched path serves widths where remat measured 5× slower than the
+    fitting tape — docs/PERF.md §7)."""
+    n_layers = params["rx"].shape[0]
+    for layer in range(n_layers):
+        state = ansatz_layer_b(
+            state, n_qubits, params["rx"][layer], params["rz"][layer]
+        )
+    return state
+
+
+def data_reuploading_b(features, params: dict):
+    """Batched-slab twin of ``data_reuploading``: features (B, n) in [0,1];
+    re-encoding banks are per-sample RY gates (gates.ry_batched)."""
+    from qfedx_tpu.circuits.encoders import angle_amplitudes
+    from qfedx_tpu.ops.batched import apply_gate_b, bstate_product
+
+    n_layers, n_qubits = params["rx"].shape
+    for layer in range(n_layers):
+        angles = (
+            params["enc_w"][layer][None] * (features * jnp.pi)
+            + params["enc_b"][layer][None]
+        )
+        if layer == 0:
+            state = bstate_product(angle_amplitudes(angles, "ry"))
+        else:
+            for q in range(n_qubits):
+                state = apply_gate_b(
+                    state, n_qubits, gates.ry_batched(angles[:, q]), q
+                )
+        state = ansatz_layer_b(
+            state, n_qubits, params["rx"][layer], params["rz"][layer]
+        )
+    return state
+
+
 def init_reuploading_params(
     key: jax.Array, n_qubits: int, n_layers: int, scale: float = 0.1
 ) -> dict:

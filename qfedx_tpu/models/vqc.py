@@ -41,12 +41,6 @@ def wrap_angle(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.mod(x + jnp.pi, 2 * jnp.pi) - jnp.pi
 
 
-def _fused_route(n_qubits: int) -> bool:
-    from qfedx_tpu.ops.fused_hea import fused_enabled
-
-    return fused_enabled(n_qubits)
-
-
 def make_vqc_classifier(
     n_qubits: int,
     n_layers: int = 2,
@@ -123,53 +117,58 @@ def make_vqc_classifier(
         if circuit_noise:
             eval_noise = eval_noise.composed(n_layers)
 
-    # Fused whole-circuit kernel (ops.fused_hea): the angle-encoded HEA —
-    # and the data-reuploading variant (per-sample in-kernel encoder
-    # gates; needs L·n ≤ 128 angle columns) — forward+backward as ONE
-    # VMEM-resident Pallas program instead of ~2·L·n HBM passes. Exact
-    # same circuit, so it is a pure performance routing. The decision is
-    # made lazily at first apply (not at model build) because the
-    # auto-route probes the backend platform — doing that at build time
-    # would initialize the backend as a side effect, pinning the platform
-    # before callers could select one.
-    fused_candidate = noise_model is None and (
-        (encoding == "angle" and basis == "ry")
-        or (encoding == "reupload" and n_layers * n_qubits <= 128)
+    # Batched slab engine (ops.batched): whole-batch forward with batch
+    # folded into slab rows instead of a vmap batch axis. Pure performance
+    # routing (same circuit): the vmap form's rank-(n+1) intermediates get
+    # batch-minor layouts from XLA inside scanned-batch training — 2–5×
+    # slower at n ≥ 16 (docs/PERF.md §8). Engages at slab widths on TPU
+    # (QFEDX_BATCHED pins); remat requests fall back to the vmap path.
+    # The decision is made lazily at first apply (not at model build)
+    # because the auto-route probes the backend platform — doing that at
+    # build time would initialize the backend as a side effect, pinning
+    # the platform before callers could select one.
+    batched_candidate = noise_model is None and not remat and encoding in (
+        "angle", "amplitude", "reupload"
     )
-    _fused_cell: list = []
+    _batched_cell: list = []
 
-    def _use_fused() -> bool:
-        if not fused_candidate:
+    def _use_batched() -> bool:
+        if not batched_candidate:
             return False
-        if not _fused_cell:
-            _fused_cell.append(_fused_route(n_qubits))
-        return _fused_cell[0]
+        if not _batched_cell:
+            from qfedx_tpu.ops.batched import batched_enabled
+
+            _batched_cell.append(batched_enabled(n_qubits))
+        return _batched_cell[0]
+
+    def _apply_batched(params, x):
+        from qfedx_tpu.circuits.ansatz import (
+            data_reuploading_b,
+            hardware_efficient_b,
+        )
+        from qfedx_tpu.circuits.encoders import angle_amplitudes
+        from qfedx_tpu.ops.batched import (
+            bstate_amplitude,
+            bstate_product,
+            expect_z_all_b,
+        )
+        from qfedx_tpu.ops.cpx import state_dtype
+
+        a = params["ansatz"]
+        if encoding == "reupload":
+            state = data_reuploading_b(x, a)
+        else:
+            if encoding == "amplitude":
+                state = bstate_amplitude(x, state_dtype())
+            else:
+                state = bstate_product(angle_amplitudes(x * jnp.pi, basis))
+            state = hardware_efficient_b(state, n_qubits, a)
+        z = expect_z_all_b(state, n_qubits)[:, : params["readout"]["scale"].shape[0]]
+        return params["readout"]["scale"] * z + params["readout"]["bias"]
 
     def apply(params, x):
-        if _use_fused():
-            a = params["ansatz"]
-            if encoding == "reupload":
-                from qfedx_tpu.ops.fused_hea import hea_reupload_zexp
-
-                # Per-sample encoder angles a_{l,q} = enc_w·(π·x) + enc_b,
-                # computed here in plain JAX so autodiff chains the
-                # kernel's angle cotangent to enc_w/enc_b/x.
-                ang = (
-                    a["enc_w"][None] * (x[:, None, :] * jnp.pi)
-                    + a["enc_b"][None]
-                ).reshape(x.shape[0], n_layers * n_qubits)
-                zexp = hea_reupload_zexp(
-                    a["rx"], a["rz"], ang, n_qubits, n_layers
-                )
-            else:
-                from qfedx_tpu.ops.fused_hea import hea_zexp
-
-                enc = jax.vmap(
-                    lambda xi: angle_encode(xi, basis).re.reshape(-1)
-                )(x)
-                zexp = hea_zexp(a["rx"], a["rz"], enc, n_qubits, n_layers)
-            z = zexp[:, : params["readout"]["scale"].shape[0]]
-            return params["readout"]["scale"] * z + params["readout"]["bias"]
+        if _use_batched():
+            return _apply_batched(params, x)
 
         def one(xi):
             state = forward_state(params, xi)

@@ -1,0 +1,331 @@
+"""Batched slab statevector engine — batch folded into slab rows.
+
+Why this exists (r05, measured on v5e — docs/PERF.md §8): the dense engine
+batches over samples with ``jax.vmap``, whose canonical per-sample state is
+the rank-n ``(2,)*n`` tensor — rank 21 at 20 qubits once vmap adds the
+batch axis. Inside a ``lax.scan`` over *changing* batches (the federated
+local-update structure, fed/client.py), XLA's layout assignment demotes the
+batch dimension of hundreds of those high-rank intermediates to most-minor
+(``{0,4,3,2,1}``-style layouts), which strides every row/lane-structured
+gate pass: the same fwd+grad step measured 27.7 ms with a loop-invariant
+batch vs 61.7 ms with scanned batches, and 157 ms under a client ``vmap``
+on top. With batch *folded into the slab row dimension* — canonical state
+``(B, 2^n)``, every view ``(B·a, 2, c, 128)`` — no tensor ever exceeds
+rank 6, the minor dim is always the 128-lane register, and there is no
+separate batch axis for layout assignment to demote: 39 ms/step in the
+same scanned harness.
+
+This module is the batched twin of ``ops.statevector``'s slab path (same
+row/lane split, same structured-matmul lane gates, same flip/select row
+gates — see the design rationale there); ``models.vqc`` routes whole-batch
+applies here at slab widths. Per-sample gates (data reuploading: one
+rotation *per sample* per qubit) keep the batch axis separate only inside
+the affected view — shared-coefficient gates always run batch-folded.
+
+Capability anchor: reference src/QFed/qAmplitude.py:44-46 is the simulator
+being replaced; reference ROADMAP.md:86 names 20 qubits as the dense
+frontier this path serves.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from qfedx_tpu.ops.cpx import CArray
+from qfedx_tpu.ops.statevector import (
+    _LANE_BITS,
+    _LANES,
+    _SLAB_MIN,
+    _lane_mt,
+    _lane_perm_cnot,
+    _lane_perm_flip,
+    _slab_pos,
+)
+
+
+def batched_enabled(n_qubits: int) -> bool:
+    """Route whole-batch applies through this engine?  Slab widths only;
+    QFEDX_BATCHED=0/1 pins, default = TPU backend (the layout pathology
+    this engine fixes is a TPU layout-assignment behavior, and the
+    flip-heavy programs compile pathologically on XLA:CPU — the same
+    per-backend split as statevector._gate_form). Read at trace time;
+    like QFEDX_DTYPE, set it before first trace."""
+    if n_qubits < _SLAB_MIN:
+        return False
+    env = os.environ.get("QFEDX_BATCHED")
+    if env is not None:
+        if env not in ("0", "1"):
+            raise ValueError(f"QFEDX_BATCHED={env!r}: expected '0' or '1'")
+        return env == "1"
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001 — no backend yet: conservative
+        return False
+
+
+def _cmap(c: CArray, f) -> CArray:
+    return CArray(f(c.re), None if c.im is None else f(c.im))
+
+
+def _cast_parts(gate: CArray, dtype):
+    gre = gate.re.astype(dtype)
+    gim = None if gate.im is None else gate.im.astype(dtype)
+    return gre, gim
+
+
+def bstate_product(amps: CArray) -> CArray:
+    """Product state from per-qubit 2-vectors: (B, n, 2) → (B, 2^n).
+
+    The batched analog of ``statevector.product_state``: iterative outer
+    products with the state kept rank-2 (batch, flat) throughout — no
+    high-rank intermediates at any width.
+    """
+    b, n, _ = amps.shape
+
+    def outer(state: CArray, q: int) -> CArray:
+        a_re = amps.re[:, q, :]
+        a_im = None if amps.im is None else amps.im[:, q, :]
+        rr = state.re[:, :, None] * a_re[:, None, :]
+        if state.im is None and a_im is None:
+            return _cmap(CArray(rr, None), lambda s: s.reshape(b, -1))
+        s_im = (
+            jnp.zeros_like(state.re) if state.im is None else state.im
+        )
+        g_im = jnp.zeros_like(a_re) if a_im is None else a_im
+        out = CArray(
+            rr - s_im[:, :, None] * g_im[:, None, :],
+            state.re[:, :, None] * g_im[:, None, :]
+            + s_im[:, :, None] * a_re[:, None, :],
+        )
+        return _cmap(out, lambda s: s.reshape(b, -1))
+
+    state = CArray(
+        amps.re[:, 0, :], None if amps.im is None else amps.im[:, 0, :]
+    )
+    for q in range(1, n):
+        state = outer(state, q)
+    return state
+
+
+def bstate_amplitude(x: jnp.ndarray, dtype) -> CArray:
+    """ℓ2-normalized amplitudes: (B, 2^n) → real state, uniform fallback
+    for all-zero rows (reference qAmplitude.py:17-21), batched."""
+    x = jnp.asarray(x, dtype=jnp.float32)
+    size = x.shape[-1]
+    norm = jnp.linalg.norm(x, axis=-1, keepdims=True)
+    uniform = jnp.full_like(x, 1.0 / jnp.sqrt(size))
+    safe = jnp.where(norm > 0, x / jnp.where(norm > 0, norm, 1.0), uniform)
+    return CArray(safe.astype(dtype), None)
+
+
+def _row_view(s: jnp.ndarray, b: int, n: int, qubit: int, fold: bool):
+    """(B·a, 2, c, 128) view (fold=True, shared gates) or (B, a, 2, c, 128)
+    (fold=False, per-sample gates) splitting the row index at ``qubit``."""
+    a = 1 << qubit
+    c = 1 << (n - _LANE_BITS - qubit - 1)
+    if fold:
+        return s.reshape(b * a, 2, c, _LANES)
+    return s.reshape(b, a, 2, c, _LANES)
+
+
+def _diag_coeffs(gre, gim, per_sample: bool, fold: bool):
+    """Diagonal/off-diagonal gate coefficients broadcast for the row view.
+
+    Shared gate (2,2): shapes (1,2,1,1) against (B·a,2,c,128).
+    Per-sample gate (B,2,2): shapes (B,1,2,1,1) against (B,a,2,c,128).
+    """
+    idx = jnp.arange(2)
+    if per_sample:
+        assert not fold
+        shp = (-1, 1, 2, 1, 1)
+        ud_re = gre[:, idx, idx].reshape(shp)
+        uo_re = gre[:, idx, 1 - idx].reshape(shp)
+        ud_im = None if gim is None else gim[:, idx, idx].reshape(shp)
+        uo_im = None if gim is None else gim[:, idx, 1 - idx].reshape(shp)
+    else:
+        shp = (1, 2, 1, 1) if fold else (1, 1, 2, 1, 1)
+        ud_re = gre[idx, idx].reshape(shp)
+        uo_re = gre[idx, 1 - idx].reshape(shp)
+        ud_im = None if gim is None else gim[idx, idx].reshape(shp)
+        uo_im = None if gim is None else gim[idx, 1 - idx].reshape(shp)
+    return ud_re, uo_re, ud_im, uo_im
+
+
+def _row_gate(state: CArray, b: int, n: int, gate: CArray, qubit: int,
+              per_sample: bool) -> CArray:
+    """Row-qubit gate in flip/select form on the batched slab."""
+    dtype = state.re.dtype
+    gre, gim = _cast_parts(gate, dtype)
+    fold = not per_sample
+    axis = 1 if fold else 2
+    ud_re, uo_re, ud_im, uo_im = _diag_coeffs(gre, gim, per_sample, fold)
+    shape = state.re.shape
+
+    def view(s):
+        return _row_view(s, b, n, qubit, fold)
+
+    def lin(ud, uo, v, f):
+        return ud * v + uo * f
+
+    v_re = view(state.re)
+    f_re = jnp.flip(v_re, axis)
+    if gim is None and state.im is None:
+        return CArray(lin(ud_re, uo_re, v_re, f_re).reshape(shape), None)
+    if gim is None:
+        v_im = view(state.im)
+        f_im = jnp.flip(v_im, axis)
+        return CArray(
+            lin(ud_re, uo_re, v_re, f_re).reshape(shape),
+            lin(ud_re, uo_re, v_im, f_im).reshape(shape),
+        )
+    if state.im is None:
+        return CArray(
+            lin(ud_re, uo_re, v_re, f_re).reshape(shape),
+            lin(ud_im, uo_im, v_re, f_re).reshape(shape),
+        )
+    v_im = view(state.im)
+    f_im = jnp.flip(v_im, axis)
+    return CArray(
+        (lin(ud_re, uo_re, v_re, f_re) - lin(ud_im, uo_im, v_im, f_im))
+        .reshape(shape),
+        (lin(ud_re, uo_re, v_im, f_im) + lin(ud_im, uo_im, v_re, f_re))
+        .reshape(shape),
+    )
+
+
+def _lane_matmul(state: CArray, b: int, mt_re, mt_im,
+                 per_sample: bool) -> CArray:
+    """s @ Mt on the (…, 128) lane dim; per-sample uses a batched matmul
+    (B, R, 128) × (B, 128, 128) on the MXU."""
+    shape = state.re.shape
+    if per_sample:
+        def mm(s, m):
+            return jnp.einsum("brl,blk->brk", s.reshape(b, -1, _LANES), m)
+    else:
+        def mm(s, m):
+            return s.reshape(-1, _LANES) @ m
+
+    rr = mm(state.re, mt_re)
+    if mt_im is None and state.im is None:
+        return CArray(rr.reshape(shape), None)
+    if mt_im is None:
+        return CArray(rr.reshape(shape), mm(state.im, mt_re).reshape(shape))
+    if state.im is None:
+        return CArray(rr.reshape(shape), mm(state.re, mt_im).reshape(shape))
+    return CArray(
+        (rr - mm(state.im, mt_im)).reshape(shape),
+        (mm(state.im, mt_re) + mm(state.re, mt_im)).reshape(shape),
+    )
+
+
+def apply_gate_b(state: CArray, n: int, gate: CArray, qubit: int) -> CArray:
+    """Apply a 1-qubit gate to a batched (B, 2^n) state.
+
+    ``gate``: (2,2) CArray shared across the batch, or (B,2,2) per-sample
+    (the data-reuploading encoder banks). Requires n ≥ _SLAB_MIN.
+    """
+    if n < _SLAB_MIN:
+        raise ValueError(f"batched engine needs n ≥ {_SLAB_MIN}, got {n}")
+    b = state.re.shape[0]
+    per_sample = gate.re.ndim == 3
+    dtype = state.re.dtype
+    if qubit >= n - _LANE_BITS:  # lane qubit → structured matmul
+        gre, gim = _cast_parts(gate, dtype)
+        p = _slab_pos(n, qubit)
+        mt = jax.vmap(lambda g: _lane_mt(g, p)) if per_sample else (
+            lambda g: _lane_mt(g, p)
+        )
+        mt_re = mt(gre)
+        mt_im = None if gim is None else mt(gim)
+        return _lane_matmul(state, b, mt_re, mt_im, per_sample)
+    return _row_gate(state, b, n, gate, qubit, per_sample)
+
+
+def apply_cnot_b(state: CArray, n: int, ctrl: int, tgt: int) -> CArray:
+    """CNOT on a batched (B, 2^n) state: four row/lane cases, batch-folded."""
+    if n < _SLAB_MIN:
+        raise ValueError(f"batched engine needs n ≥ {_SLAB_MIN}, got {n}")
+    b = state.re.shape[0]
+    dtype = state.re.dtype
+    shape = state.re.shape
+    row_limit = n - _LANE_BITS
+    c_row, t_row = ctrl < row_limit, tgt < row_limit
+    if c_row and t_row:
+        lo, hi = (ctrl, tgt) if ctrl < tgt else (tgt, ctrl)
+        a = 1 << lo
+        m = 1 << (hi - lo - 1)
+        c = 1 << (row_limit - hi - 1)
+        view = (b * a, 2, m, 2, c, _LANES)
+        ax_c, ax_t = (1, 3) if ctrl < tgt else (3, 1)
+        mask_shape = [1] * 6
+        mask_shape[ax_c] = 2
+        mask = jnp.arange(2, dtype=jnp.int32).reshape(mask_shape) == 1
+
+        def one(s):
+            v = s.reshape(view)
+            return jnp.where(mask, jnp.flip(v, ax_t), v).reshape(shape)
+
+        return _cmap(state, one)
+    if not c_row and not t_row:
+        mt = _lane_perm_cnot(_slab_pos(n, ctrl), _slab_pos(n, tgt), dtype)
+
+        def one(s):
+            return (s.reshape(-1, _LANES) @ mt).reshape(shape)
+
+        return _cmap(state, one)
+    if c_row:  # control in rows, target in lanes
+        mask = jnp.arange(2, dtype=jnp.int32).reshape(1, 2, 1, 1) == 1
+        p = _lane_perm_flip(_slab_pos(n, tgt), dtype)
+
+        def one(s):
+            v = _row_view(s, b, n, ctrl, fold=True)
+            return jnp.where(mask, v @ p, v).reshape(shape)
+
+        return _cmap(state, one)
+    # control in lanes, target in rows
+    lane_bit = (
+        jax.lax.broadcasted_iota(jnp.int32, (_LANES,), 0)
+        >> _slab_pos(n, ctrl)
+    ) & 1
+    mask = (lane_bit == 1).reshape(1, 1, 1, _LANES)
+
+    def one(s):
+        v = _row_view(s, b, n, tgt, fold=True)
+        return jnp.where(mask, jnp.flip(v, 1), v).reshape(shape)
+
+    return _cmap(state, one)
+
+
+def probabilities_b(state: CArray) -> jnp.ndarray:
+    """|ψ|² per sample, (B, 2^n) f32."""
+    p = jnp.square(state.re.astype(jnp.float32))
+    if state.im is not None:
+        p = p + jnp.square(state.im.astype(jnp.float32))
+    return p
+
+
+def expect_z_all_b(state: CArray, n: int) -> jnp.ndarray:
+    """⟨Z_k⟩ ∀k per sample: (B, 2^n) → (B, n) f32 via the two-pass slab
+    reduction (row sums + lane sums — see statevector._slab_z_all)."""
+    probs = probabilities_b(state)
+    b = probs.shape[0]
+    rbits = n - _LANE_BITS
+    slab = probs.reshape(b, 1 << rbits, _LANES)
+    row_sums = jnp.sum(slab, axis=2, dtype=jnp.float32)  # (B, R)
+    lane_sums = jnp.sum(slab, axis=1, dtype=jnp.float32)  # (B, 128)
+    out = []
+    for k in range(rbits):
+        a, c = 1 << k, 1 << (rbits - k - 1)
+        marg = jnp.sum(row_sums.reshape(b, a, 2, c), axis=(1, 3))
+        out.append(marg[:, 0] - marg[:, 1])
+    lane = jax.lax.broadcasted_iota(jnp.int32, (_LANES, _LANE_BITS), 0)
+    bitpos = (_LANE_BITS - 1) - jax.lax.broadcasted_iota(
+        jnp.int32, (_LANES, _LANE_BITS), 1
+    )
+    zmat = 1.0 - 2.0 * ((lane >> bitpos) & 1).astype(jnp.float32)
+    return jnp.concatenate(
+        [jnp.stack(out, axis=1), lane_sums @ zmat], axis=1
+    )

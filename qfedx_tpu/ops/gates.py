@@ -91,6 +91,18 @@ def rz(theta) -> CArray:
 ROTATIONS = {"rx": rx, "ry": ry, "rz": rz}
 
 
+def ry_batched(theta) -> CArray:
+    """RY per-sample: angles (B,) → (B, 2, 2) real gate stack (the
+    data-reuploading encoder banks on the batched slab engine,
+    ops.batched.apply_gate_b's per-sample form)."""
+    theta = jnp.asarray(theta, dtype=RDTYPE)
+    c, s = jnp.cos(theta / 2), jnp.sin(theta / 2)
+    re = jnp.stack(
+        [jnp.stack([c, -s], axis=-1), jnp.stack([s, c], axis=-1)], axis=-2
+    )
+    return CArray(re, None)
+
+
 def rot_zx(theta, phi) -> CArray:
     """RZ(φ)·RX(θ) fused into one 2×2 gate.
 

@@ -16,7 +16,8 @@ src/QFed/qAmplitude.py:44-46). Design (SURVEY.md §7.1.1):
 - States with n ≥ ``_SLAB_MIN`` qubits additionally route through the
   (R, 128) slab layout: row-qubit gates stay elementwise on leading axes,
   lane-qubit gates become (R,128)×(128,128) structured matmuls on the MXU
-  — the TPU-native split (same as the fused Pallas kernel's), which also
+  — the TPU-native split (shared with the retired r04 Pallas kernel,
+  docs/PERF.md §4), which also
   removes the old high-rank XLA compile wall (n=20 compiles in minutes).
 - Batching over samples is ``jax.vmap``; everything is jit-compatible with
   static circuit structure (qubit indices are Python ints at trace time).
@@ -106,7 +107,10 @@ def _gate_form() -> str:
     → 90+ min), while the dot form compiles instantly there. So: flip on
     TPU, dot on CPU; QFEDX_GATE_FORM pins either (the slab/flip parity
     tests pin "flip" to keep the TPU path covered on CPU). Read at trace
-    time."""
+    time and, like QFEDX_DTYPE, not part of any jit cache key: set it
+    BEFORE the first trace of a function — flipping it afterwards
+    silently keeps running the already-traced formulation (ADVICE r04
+    item 1; the wrong-path-measured error class)."""
     env = os.environ.get("QFEDX_GATE_FORM")
     if env:
         if env not in ("flip", "dot"):
@@ -256,8 +260,8 @@ _FLAT_RANK = 15
 # Slab layout: states with n ≥ _SLAB_MIN qubits are operated on as
 # (R, 128) = (2^{n-7}, 2^7) row-major views — the native TPU vector shape
 # (minor dim = one full lane register). Qubits n−7…n−1 live in the lane
-# dim, qubits 0…n−8 in the row dim (same split as the fused Pallas kernel,
-# ops/fused_hea.py). Why: a profiler trace of the r03 engine (docs/PERF.md)
+# dim, qubits 0…n−8 in the row dim (the split the retired r04 fused
+# Pallas kernel pioneered — docs/PERF.md §4). Why: a profiler trace of the r03 engine (docs/PERF.md)
 # showed 53% of device time in materialized transposes/relayout copies from
 # rank-n contractions, and reverses along minor axes run ~10× below HBM
 # peak. In slab form:
@@ -287,7 +291,8 @@ def _lane_strategy() -> str:
     backend they are very much not (the 8-device virtual test mesh slowed
     ~4×), so CPU defaults to "flip". QFEDX_SLAB_LANES pins either choice
     (the slab parity/bf16 tests pin "matmul" to cover the TPU path on
-    CPU). Read at trace time."""
+    CPU). Read at TRACE time, not part of any jit cache key — set BEFORE
+    first trace (see _gate_form)."""
     env = os.environ.get("QFEDX_SLAB_LANES")
     if env:
         if env not in ("matmul", "flip"):

@@ -1,0 +1,218 @@
+"""Batched slab engine (ops.batched) ≡ the vmapped dense engine.
+
+The batched path is a pure performance routing (docs/PERF.md §8): batch
+folded into slab rows instead of a vmap axis. Every op and the full model
+must match the vmapped dense engine exactly — these tests pin the parity
+on the CPU mesh (QFEDX_BATCHED=1 forces the TPU production routing here).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qfedx_tpu.ops import gates
+from qfedx_tpu.ops.batched import (
+    apply_cnot_b,
+    apply_gate_b,
+    bstate_amplitude,
+    bstate_product,
+    expect_z_all_b,
+)
+from qfedx_tpu.ops.cpx import CArray
+from qfedx_tpu.ops.statevector import (
+    apply_cnot,
+    apply_gate,
+    expect_z_all,
+    product_state,
+)
+
+N = 10  # smallest slab width (statevector._SLAB_MIN)
+B = 3
+
+
+def _rand_bstate(seed: int, complex_: bool = True) -> CArray:
+    rng = np.random.default_rng(seed)
+    re = jnp.asarray(rng.standard_normal((B, 1 << N)), dtype=jnp.float32)
+    if not complex_:
+        return CArray(re, None)
+    im = jnp.asarray(rng.standard_normal((B, 1 << N)), dtype=jnp.float32)
+    return CArray(re, im)
+
+
+def _as_tensors(state: CArray) -> CArray:
+    """(B, 2^n) → (B,) + (2,)*n for the vmapped reference engine."""
+    shape = (B,) + (2,) * N
+    return CArray(
+        state.re.reshape(shape),
+        None if state.im is None else state.im.reshape(shape),
+    )
+
+
+def _flat(state: CArray) -> np.ndarray:
+    re = np.asarray(state.re).reshape(B, -1)
+    im = (
+        np.zeros_like(re)
+        if state.im is None
+        else np.asarray(state.im).reshape(B, -1)
+    )
+    return re + 1j * im
+
+
+def assert_state_close(a: CArray, b: CArray, atol=1e-5):
+    np.testing.assert_allclose(_flat(a), _flat(b), atol=atol, rtol=0)
+
+
+def test_product_state_parity():
+    rng = np.random.default_rng(0)
+    angles = jnp.asarray(rng.uniform(0, np.pi, (B, N)), dtype=jnp.float32)
+    from qfedx_tpu.circuits.encoders import angle_amplitudes
+
+    batched = bstate_product(angle_amplitudes(angles, "ry"))
+    ref = jax.vmap(lambda a: product_state(angle_amplitudes(a, "ry")))(angles)
+    assert_state_close(batched, CArray(ref.re.reshape(B, -1), None))
+
+
+def test_product_state_complex_parity():
+    rng = np.random.default_rng(1)
+    angles = jnp.asarray(rng.uniform(0, np.pi, (B, N)), dtype=jnp.float32)
+    from qfedx_tpu.circuits.encoders import angle_amplitudes
+
+    batched = bstate_product(angle_amplitudes(angles, "rx"))
+    ref = jax.vmap(lambda a: product_state(angle_amplitudes(a, "rx")))(angles)
+    assert_state_close(
+        batched,
+        CArray(ref.re.reshape(B, -1), ref.im.reshape(B, -1)),
+    )
+
+
+def test_amplitude_parity():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((B, 1 << N)), dtype=jnp.float32)
+    x = x.at[1].set(0.0)  # exercise the uniform fallback row
+    from qfedx_tpu.circuits.encoders import amplitude_encode
+
+    batched = bstate_amplitude(x, jnp.float32)
+    ref = jax.vmap(amplitude_encode)(x)
+    assert_state_close(batched, CArray(ref.re.reshape(B, -1), None))
+
+
+@pytest.mark.parametrize("qubit", [0, 2, N - 7, N - 1])  # row and lane
+@pytest.mark.parametrize("complex_state", [False, True])
+def test_gate_parity(qubit, complex_state):
+    state = _rand_bstate(3, complex_state)
+    g = gates.rot_zx(jnp.float32(0.7), jnp.float32(-0.3))
+    batched = apply_gate_b(state, N, g, qubit)
+    ref = jax.vmap(lambda s_re, s_im: apply_gate(
+        CArray(s_re, s_im if complex_state else None), g, qubit
+    ))(
+        _as_tensors(state).re,
+        _as_tensors(state).im if complex_state else _as_tensors(state).re,
+    )
+    assert_state_close(batched, ref)
+
+
+@pytest.mark.parametrize("qubit", [1, N - 2])  # row and lane
+def test_per_sample_gate_parity(qubit):
+    state = _rand_bstate(4, complex_=True)
+    thetas = jnp.asarray([0.3, -1.2, 2.5], dtype=jnp.float32)
+    batched = apply_gate_b(state, N, gates.ry_batched(thetas), qubit)
+    tens = _as_tensors(state)
+    ref = jax.vmap(
+        lambda s_re, s_im, t: apply_gate(CArray(s_re, s_im), gates.ry(t), qubit)
+    )(tens.re, tens.im, thetas)
+    assert_state_close(batched, ref)
+
+
+@pytest.mark.parametrize(
+    "ctrl,tgt",
+    [
+        (0, 1),  # row-row
+        (1, 0),  # row-row reversed
+        (N - 2, N - 1),  # lane-lane
+        (1, N - 2),  # row control, lane target
+        (N - 2, 1),  # lane control, row target
+        (N - 1, 0),  # the entangler-ring wrap gate
+    ],
+)
+def test_cnot_parity(ctrl, tgt):
+    state = _rand_bstate(5, complex_=True)
+    batched = apply_cnot_b(state, N, ctrl, tgt)
+    tens = _as_tensors(state)
+    ref = jax.vmap(
+        lambda s_re, s_im: apply_cnot(CArray(s_re, s_im), ctrl, tgt)
+    )(tens.re, tens.im)
+    assert_state_close(batched, ref)
+
+
+def test_expect_z_parity():
+    state = _rand_bstate(6, complex_=True)
+    batched = expect_z_all_b(state, N)
+    tens = _as_tensors(state)
+    ref = jax.vmap(lambda s_re, s_im: expect_z_all(CArray(s_re, s_im)))(
+        tens.re, tens.im
+    )
+    np.testing.assert_allclose(
+        np.asarray(batched), np.asarray(ref), atol=1e-4, rtol=0
+    )
+
+
+@pytest.mark.parametrize("encoding", ["angle", "amplitude", "reupload"])
+def test_model_parity(encoding, monkeypatch):
+    """Full model: batched routing ≡ vmap routing, logits and gradients."""
+    import optax
+
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    feats = (1 << N) if encoding == "amplitude" else N
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.uniform(0, 1, (B, feats)), dtype=jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, (B,)), dtype=jnp.int32)
+
+    def loss(model):
+        def f(p):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                model.apply(p, x), y
+            ).mean()
+
+        return f
+
+    # The routing env is read lazily at FIRST APPLY (not at build), so
+    # each model's entire on/off phase — build, apply, grad — runs with
+    # its pin still set; interleaving builds then applies would run both
+    # models down the same path and pin nothing.
+    monkeypatch.setenv("QFEDX_BATCHED", "1")
+    m_on = make_vqc_classifier(
+        n_qubits=N, n_layers=2, num_classes=2, encoding=encoding
+    )
+    params = m_on.init(jax.random.PRNGKey(0))
+    # Spy on the batched readout so a silent routing fallback (both
+    # models running the vmap path) fails loudly instead of comparing
+    # vmap against itself.
+    hits = []
+    real = expect_z_all_b
+
+    def spy(state, n):
+        hits.append(n)
+        return real(state, n)
+
+    monkeypatch.setattr(
+        "qfedx_tpu.ops.batched.expect_z_all_b", spy
+    )
+    logits_on = np.asarray(m_on.apply(params, x))
+    monkeypatch.setattr("qfedx_tpu.ops.batched.expect_z_all_b", real)
+    assert hits, "batched routing was not exercised"
+    g_on = jax.grad(loss(m_on))(params)
+
+    monkeypatch.setenv("QFEDX_BATCHED", "0")
+    m_off = make_vqc_classifier(
+        n_qubits=N, n_layers=2, num_classes=2, encoding=encoding
+    )
+    logits_off = np.asarray(m_off.apply(params, x))
+    g_off = jax.grad(loss(m_off))(params)
+
+    np.testing.assert_allclose(logits_on, logits_off, atol=1e-5, rtol=0)
+    for a, b in zip(jax.tree.leaves(g_on), jax.tree.leaves(g_off)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=0
+        )
