@@ -15,4 +15,4 @@ A brand-new framework with the capability surface of the QFedX reference
 - ``utils``    — pytree/serialization helpers.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"

@@ -93,6 +93,27 @@ def preset_cells(preset: str) -> list[dict]:
                 _cell(f"q4-dp{sigma}", qubits=4, clients=8,
                       dp_sigma=sigma, dp_clip=1.0, **bi)
             )
+        # Quantum-noise axis (ROADMAP.md:64-73, incl. :73's acceptance
+        # check "verify noise reduces accuracy sensibly"). Depolarizing
+        # runs CIRCUIT-level (sampled Kraus trajectories after every
+        # layer, analytic layer-composed eval): at readout placement a
+        # depolarizing channel only scales ⟨Z⟩ — sign-preserving, so
+        # accuracy wouldn't move and the check would be vacuous. The
+        # q4-d2 cell is this axis's zero-noise baseline (identical
+        # knobs, depth 2 default).
+        for p_noise in (0.05, 0.15, 0.3):
+            cells.append(
+                _cell(f"q4-noise-dp{p_noise}", qubits=4, clients=8,
+                      depolarizing_p=p_noise, noise_placement="circuit",
+                      noise_axis=p_noise, **bi)
+            )
+        cells.append(
+            _cell("q4-noise-damp0.1", qubits=4, clients=8,
+                  amp_damping_gamma=0.1, noise_placement="circuit", **bi)
+        )
+        cells.append(
+            _cell("q4-noise-shots128", qubits=4, clients=8, shots=128, **bi)
+        )
         # Per-example DP-SGD point (dp mode "example"): puts a LEARNING
         # point at single-digit ε on the accuracy-vs-ε curve — the
         # client-level σ axis above only reaches single digits at σ=2,
@@ -218,6 +239,11 @@ def _config_from_cell(cell: dict, seed: int) -> ExperimentConfig:
             encoding=cell.get("encoding", "angle"),
             init_scale=cell.get("init_scale", 0.1),
             sv_size=cell.get("sv_size", 1),
+            depolarizing_p=cell.get("depolarizing_p", 0.0),
+            amp_damping_gamma=cell.get("amp_damping_gamma", 0.0),
+            readout_flip=cell.get("readout_flip", 0.0),
+            shots=cell.get("shots"),
+            noise_placement=cell.get("noise_placement", "readout"),
         ),
         fed=FedConfig(
             local_epochs=cell.get("local_epochs", 1),
@@ -289,8 +315,25 @@ def _aggregate(runs: list[dict]) -> dict:
     return out
 
 
+def _env_tag() -> str:
+    """Self-describing measurement environment for the results table
+    (VERDICT r04 weak 6: accuracy tables are generated on the CPU mesh
+    while tuning notes cite bench-chip costs — the tag makes each
+    artifact say which)."""
+    import jax
+
+    try:
+        devs = jax.devices()
+        return f"{devs[0].platform}{len(devs)}"
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
 def _markdown_table(cells: list[dict], aggs: dict) -> str:
     lines = [
+        f"Environment: `{_env_tag()}` (timings are this environment's, "
+        "not the bench chip's).",
+        "",
         "| cell | accuracy | min(seed) | AUC | ε | seeds | round s | MB/round |",
         "|---|---|---|---|---|---|---|---|",
     ]
@@ -349,6 +392,26 @@ def _plots(out_dir: Path, cells: list[dict], aggs: dict) -> None:
                     bbox_inches="tight")
         plt.close(fig)
 
+    # accuracy vs noise strength (ROADMAP.md:73's acceptance check):
+    # the circuit-level depolarizing axis, with q4-d2 (identical knobs,
+    # zero noise) as the p=0 anchor when present.
+    noise_cells = sorted(
+        (c["noise_axis"], c["name"]) for c in cells if "noise_axis" in c
+    )
+    if len(noise_cells) >= 2:
+        xs = [p for p, _ in noise_cells]
+        names = [n for _, n in noise_cells]
+        if any(c["name"] == "q4-d2" for c in cells):
+            xs, names = [0.0] + xs, ["q4-d2"] + names
+        fig, ax = plt.subplots(figsize=(5, 4))
+        errbar(ax, xs, names)
+        ax.set_xlabel("depolarizing p (circuit-level, per layer)")
+        ax.set_ylabel("test accuracy")
+        ax.set_title("noise degrades accuracy")
+        fig.savefig(out_dir / "accuracy_vs_noise.png", dpi=120,
+                    bbox_inches="tight")
+        plt.close(fig)
+
     # speedup vs clients: per-round time scaling, drawn ONLY from cells
     # explicitly marked scaling=True (same model/config, cohort size the
     # single varying knob) — mixing heterogeneous cells here would publish
@@ -389,18 +452,18 @@ def run_sweep(
     if is_primary():
         out_dir.mkdir(parents=True, exist_ok=True)
 
-    # ROADMAP.md:119 allows 3–5 seeds: start at ``seeds``, escalate to 5
-    # when the accuracy spread is wide (std > 0.1) so high-variance cells
-    # report a seed count that matches their noise level.
+    # ROADMAP.md:119 allows 3–5 seeds: start at ``seeds``; if the accuracy
+    # spread over those is wide (std > 0.1), run ALL the way to 5. The
+    # trigger is checked once, after the base seeds — stopping the moment
+    # std dips back under the bar would be data-dependent optional
+    # stopping, biasing per-cell means toward seed sets that happen to
+    # look stable (ADVICE r04 item 2).
     max_seeds = max(seeds, 5)
     all_runs: dict[str, list[dict]] = {}
     for ci, cell in enumerate(cells):
         runs = []
-        s = 0
-        while s < seeds or (
-            s < max_seeds
-            and float(np.std([r["accuracy"] for r in runs])) > 0.1
-        ):
+        s, target = 0, seeds
+        while s < target:
             t0 = time.perf_counter()
             runs.append(_run_cell(cell, seed=42 + s))
             say(
@@ -409,11 +472,18 @@ def run_sweep(
                 f"({time.perf_counter() - t0:.1f}s)"
             )
             s += 1
+            if (
+                s == target
+                and target < max_seeds
+                and float(np.std([r["accuracy"] for r in runs])) > 0.1
+            ):
+                target = max_seeds
         all_runs[cell["name"]] = runs
 
     aggs = {name: _aggregate(runs) for name, runs in all_runs.items()}
     result = {
         "preset": preset,
+        "env": _env_tag(),
         "seeds": seeds,
         "cells": [dict(c) for c in cells],
         "runs": all_runs,

@@ -38,13 +38,11 @@ def test_sweep_quick_end_to_end(tmp_path):
         if a["n_seeds"] == 2:
             assert float(np.std(accs)) <= 0.1
         else:
-            # Escalation may settle anywhere in 3..5: each extra seed was
-            # demanded by std > 0.1 over the runs before it, and it stops
-            # early only once std drops back under the bar.
-            assert 3 <= a["n_seeds"] <= 5
-            assert float(np.std(accs[:-1])) > 0.1  # last seed was demanded
-            if a["n_seeds"] < 5:
-                assert float(np.std(accs)) <= 0.1  # and settled the cell
+            # Escalation runs ALL the way to 5 once triggered (no
+            # data-dependent early stop — ADVICE r04 item 2): the
+            # trigger is std > 0.1 over the base seeds.
+            assert a["n_seeds"] == 5
+            assert float(np.std(accs[:2])) > 0.1  # the base-seed trigger
         assert a["accuracy_min"] == pytest.approx(min(accs))
         assert 0.0 <= a["accuracy_mean"] <= 1.0 and a["accuracy_std"] >= 0.0
         assert a["comm_mb_per_round"] > 0
