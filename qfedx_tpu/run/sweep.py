@@ -105,7 +105,7 @@ def preset_cells(preset: str) -> list[dict]:
             cells.append(
                 _cell(f"q4-noise-dp{p_noise}", qubits=4, clients=8,
                       depolarizing_p=p_noise, noise_placement="circuit",
-                      noise_axis=p_noise, **bi)
+                      **bi)
             )
         cells.append(
             _cell("q4-noise-damp0.1", qubits=4, clients=8,
@@ -396,7 +396,9 @@ def _plots(out_dir: Path, cells: list[dict], aggs: dict) -> None:
     # the circuit-level depolarizing axis, with q4-d2 (identical knobs,
     # zero noise) as the p=0 anchor when present.
     noise_cells = sorted(
-        (c["noise_axis"], c["name"]) for c in cells if "noise_axis" in c
+        (c["depolarizing_p"], c["name"])
+        for c in cells
+        if c.get("noise_placement") == "circuit" and c.get("depolarizing_p")
     )
     if len(noise_cells) >= 2:
         xs = [p for p, _ in noise_cells]
