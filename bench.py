@@ -344,14 +344,18 @@ def _bench_compute_bound(jax, n_qubits=16, n_layers=3, batch=64, reps=5,
 
 def _bench_fed16q(jax, rounds_per_call=10, reps=3):
     """The COMPOSED path at a simulation-dominated width: K scanned
-    federated rounds (shard_map + client vmap + epoch/batch scans) with the
-    16-qubit 3-layer VQC, 2 clients on one chip. The quantity the north
-    star actually scores — client-rounds/s — where the engine, not
-    dispatch, is the cost (VERDICT r04 missing 3). The r05 batched slab
-    engine (docs/PERF.md §8) exists because this composition once ran
-    2–5× slower than bare fwd+grad × steps."""
+    federated rounds (shard_map + epoch/batch scans) with the 16-qubit
+    3-layer VQC, 2 clients on one chip. The quantity the north star
+    actually scores — client-rounds/s — where the engine, not dispatch,
+    is the cost (VERDICT r04 missing 3). From r06 the round folds the
+    client axis into the batched slab (per-client gate coefficients,
+    fed.round fold_clients_enabled; docs/PERF.md §10) instead of vmapping
+    the engine over clients — QFEDX_FOLD_CLIENTS pins either form and the
+    unfolded row below keeps the lever's cost measured."""
     from qfedx_tpu.fed.config import FedConfig
-    from qfedx_tpu.fed.round import client_mesh, shard_client_data
+    from qfedx_tpu.fed.round import (
+        client_mesh, fold_clients_enabled, shard_client_data,
+    )
     from qfedx_tpu.models.vqc import make_vqc_classifier
 
     n_qubits, n_layers = 16, 3
@@ -380,6 +384,7 @@ def _bench_fed16q(jax, rounds_per_call=10, reps=3):
         "batch": batch,
         "local_steps_per_round": steps_per_round,
         "rounds_per_call": rounds_per_call,
+        "fold_clients": fold_clients_enabled(model, cfg),
         "round_s": round(per_round, 5),
         "client_rounds_per_s": round(num_clients / per_round, 2),
         # per local step per client — directly comparable to the bare
@@ -388,10 +393,56 @@ def _bench_fed16q(jax, rounds_per_call=10, reps=3):
     }
 
 
+def _target_hits(accuracies, round_times_s, target):
+    """first_touch and SUSTAINED hit from a per-round accuracy series.
+
+    ``accuracies[0]`` is the round-0 (pre-training) eval. first_touch: the
+    first round whose eval meets the target (one eval can be a spike —
+    the 20q run counted exactly such a spike as success in r05).
+    sustained: the first round of a streak of ≥ 2 consecutive evals at or
+    above the target — the round whose params genuinely reached the
+    target; a final-round hit with no successor eval cannot be confirmed
+    and does not count. Hit time = Σ per-round walls through the hit
+    round."""
+    def hit_s(rnd):
+        return (
+            round(sum(round_times_s[:rnd]), 3) if rnd is not None else None
+        )
+
+    first = next(
+        (i for i, a in enumerate(accuracies) if i > 0 and a >= target), None
+    )
+    sustained = next(
+        (
+            i
+            for i in range(1, len(accuracies) - 1)
+            if accuracies[i] >= target and accuracies[i + 1] >= target
+        ),
+        None,
+    )
+    return {
+        "seconds": hit_s(sustained),
+        "rounds": sustained,
+        "reached": sustained is not None,
+        "reached_definition": "accuracy >= target for >=2 consecutive evals",
+        "first_touch_seconds": hit_s(first),
+        "first_touch_rounds": first,
+    }
+
+
 def _bench_time_to_target(jax, target=0.90, max_rounds=40):
     """Wall-clock to ``target`` accuracy on the learnable synthetic set —
     the second north-star metric (BASELINE.json "FedAvg wall-clock to
-    target accuracy"): flagship 8-qubit config, 8 clients."""
+    target accuracy"): flagship 8-qubit config, 8 clients.
+
+    Measured HOT (r06, the r05 regression finding — docs/PERF.md §11):
+    the run executes twice and the second run is the reported one. The
+    r05 "regression" of this row was the first scanned chunk's cold-cache
+    XLA compile landing inside the timed window — total 40-round wall was
+    unchanged (18.9 → 19.5 s) while the 15-round hit time doubled, i.e.
+    the metric was measuring compile-cache state, not the engine. The
+    cold (first-run) wall is kept alongside so compile cost stays
+    visible instead of silently mixed in."""
     from qfedx_tpu.data.datasets import load_dataset
     from qfedx_tpu.data.partition import iid_partition, pack_clients
     from qfedx_tpu.data.pipeline import preprocess
@@ -406,36 +457,28 @@ def _bench_time_to_target(jax, target=0.90, max_rounds=40):
     model = make_vqc_classifier(n_qubits=8, n_layers=3, num_classes=2)
     cfg = FedConfig(local_epochs=2, batch_size=32, learning_rate=0.1, optimizer="adam")
 
-    t0 = time.perf_counter()
     # Scanned dispatch with ON-DEVICE per-round eval (rounds_per_call):
     # accuracy at every round comes out of the same device program, so
     # the timed window is training + in-scan eval, not 40 host eval
-    # round-trips. The hit round is exact (per-round accuracies from the
-    # scan); the hit TIME is the sum of recorded per-round wall times up
-    # to it (chunk compiles amortize into their chunk's rounds — the
-    # persistent cache makes them ~free after the first bench run).
-    res = train_federated(
-        model, cfg, cx, cy, cmask, *pre.test, num_rounds=max_rounds,
-        eval_every=1, seed=0, rounds_per_call=10,
-    )
-    total = time.perf_counter() - t0
-    # accuracies[0] is the round-0 (pre-training) eval.
-    hit_round = next(
-        (i for i, a in enumerate(res.accuracies) if i > 0 and a >= target),
-        None,
-    )
-    hit_s = (
-        round(sum(res.round_times_s[:hit_round]), 3)
-        if hit_round is not None
-        else None
-    )
-    return {
-        "target_accuracy": target,
-        "seconds": hit_s,
-        "rounds": hit_round,
-        "reached": hit_round is not None,
-        f"total_s_{max_rounds}_rounds": round(total, 3),
-    }
+    # round-trips. Two identical runs: the first compiles (persistent
+    # cache + in-process jit caches), the second is the hot measurement —
+    # training is seed-deterministic, so both runs hit the same rounds.
+    def one_run():
+        t0 = time.perf_counter()
+        res = train_federated(
+            model, cfg, cx, cy, cmask, *pre.test, num_rounds=max_rounds,
+            eval_every=1, seed=0, rounds_per_call=10,
+        )
+        return res, time.perf_counter() - t0
+
+    _, cold_total = one_run()
+    res, total = one_run()
+    out = {"target_accuracy": target}
+    out.update(_target_hits(res.accuracies, res.round_times_s, target))
+    out["timing"] = "hot (2nd run; cold wall kept alongside)"
+    out[f"total_s_{max_rounds}_rounds"] = round(total, 3)
+    out[f"cold_total_s_{max_rounds}_rounds"] = round(cold_total, 3)
+    return out
 
 
 def _bench_time_to_target_20q(jax, target=0.90, max_rounds=15):
@@ -469,40 +512,63 @@ def _bench_time_to_target_20q(jax, target=0.90, max_rounds=15):
         eval_every=1, seed=0,
     )
     total = time.perf_counter() - t0
-    hit_round = next(
-        (i for i, a in enumerate(res.accuracies) if i > 0 and a >= target),
-        None,
-    )
-    hit_s = (
-        round(sum(res.round_times_s[:hit_round]), 3)
-        if hit_round is not None
-        else None
-    )
-    return {
-        "n_qubits": 20,
-        "target_accuracy": target,
-        "seconds": hit_s,
-        "rounds": hit_round,
-        "reached": hit_round is not None,
-        "final_accuracy": round(float(res.accuracies[-1]), 4),
-        "round_s": round(
-            float(np.median(np.asarray(res.round_times_s[1:]))), 3
-        ) if len(res.round_times_s) > 1 else None,
-        f"total_s_{max_rounds}_rounds": round(total, 3),
-    }
+    out = {"n_qubits": 20, "target_accuracy": target}
+    # Sustained (≥2 consecutive evals) semantics: the r05 row counted a
+    # single round-9 eval spike as "reached" while final_accuracy sat at
+    # 0.82 — first_touch still records that spike, but it no longer
+    # counts as success. Single (cold) run: a hot repeat would double the
+    # longest bench section and this row carries no regression flag.
+    out.update(_target_hits(res.accuracies, res.round_times_s, target))
+    out["timing"] = "cold (single run; compile in first chunks)"
+    out["final_accuracy"] = round(float(res.accuracies[-1]), 4)
+    out["round_s"] = round(
+        float(np.median(np.asarray(res.round_times_s[1:]))), 3
+    ) if len(res.round_times_s) > 1 else None
+    out[f"total_s_{max_rounds}_rounds"] = round(total, 3)
+    return out
+
+
+# Rounds before this one timed per-rep blocks without chained dispatches or
+# fetch anchoring (docs/PERF.md §6) — their numbers over-count dispatch
+# overhead and are NOT comparable to r04+ rows. _load_prev_bench skips
+# them rather than silently producing apples-to-oranges ratios (the r05
+# run compared against BENCH_r03 exactly this way — ADVICE r05).
+_FIRST_COMPARABLE_ROUND = 4
+
+
+def _bench_round_num(path: str) -> int | None:
+    """Numeric round of a BENCH_r*.json path (lexicographic sort breaks at
+    r100+: 'r100' < 'r99')."""
+    import re
+
+    m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else None
 
 
 def _load_prev_bench():
-    """Newest committed BENCH_r*.json with a usable parsed payload (r04's
-    parsed field is null — its tail was truncated mid-object — so walk
-    backwards until a round parses)."""
+    """Newest committed BENCH_r*.json (by NUMERIC round) with a usable
+    parsed payload (r04's parsed field is null — its tail was truncated
+    mid-object — so walk backwards until a round parses). Pre-r04 rounds
+    are skipped outright (different timing methodology); the skip list is
+    returned so vs_prev can record what was excluded."""
     import glob
 
-    prevs = sorted(glob.glob(
+    paths = glob.glob(
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "BENCH_r*.json")
-    ), reverse=True)
-    for path in prevs:
+    )
+    numbered = sorted(
+        ((n, p) for p in paths if (n := _bench_round_num(p)) is not None),
+        reverse=True,
+    )
+    skipped = [
+        os.path.basename(p)
+        for n, p in numbered
+        if n < _FIRST_COMPARABLE_ROUND
+    ]
+    for n, path in numbered:
+        if n < _FIRST_COMPARABLE_ROUND:
+            continue
         try:
             with open(path) as f:
                 obj = json.load(f)
@@ -510,7 +576,7 @@ def _load_prev_bench():
             continue
         parsed = obj.get("parsed", obj)
         if isinstance(parsed, dict) and "value" in parsed:
-            return os.path.basename(path), parsed
+            return os.path.basename(path), parsed, skipped
         # Unparsed tail: recover the JSON line if the full object is there.
         tail = obj.get("tail", "")
         start = tail.find('{"metric"')
@@ -518,10 +584,10 @@ def _load_prev_bench():
             try:
                 parsed = json.loads(tail[start:].strip())
                 if "value" in parsed:
-                    return os.path.basename(path), parsed
+                    return os.path.basename(path), parsed, skipped
             except Exception:  # noqa: BLE001
                 pass
-    return None, None
+    return None, None, skipped
 
 
 def main():
@@ -594,6 +660,25 @@ def main():
     fed16_bf16 = safe(
         lambda j: _with_env({"QFEDX_DTYPE": "bf16"}, _bench_fed16q, j)
     )
+    # The client-VMAP form of the same program (QFEDX_FOLD_CLIENTS=0)
+    # keeps the folded lever's effect measured head-to-head; bf16 because
+    # that is the production fed dtype and where PERF.md §8 located the
+    # residual ~1.5× composition tax.
+    fed16_bf16_unfolded = safe(
+        lambda j: _with_env(
+            {"QFEDX_DTYPE": "bf16", "QFEDX_FOLD_CLIENTS": "0"},
+            _bench_fed16q, j,
+        )
+    )
+    if (
+        fed16_bf16.get("fold_clients") is True
+        and "client_rounds_per_s" in fed16_bf16_unfolded
+    ):
+        fed16_bf16["fold_speedup_vs_vmap"] = round(
+            fed16_bf16["client_rounds_per_s"]
+            / fed16_bf16_unfolded["client_rounds_per_s"],
+            3,
+        )
     ttt = safe(_bench_time_to_target)
     ttt20 = safe(
         lambda j: _with_env(
@@ -615,7 +700,13 @@ def main():
     # PARSEABLE committed BENCH_r*.json so drift is visible at bench time.
     vs_prev = {}
     try:
-        prev_name, prev = _load_prev_bench()
+        prev_name, prev, skipped = _load_prev_bench()
+        if skipped:
+            vs_prev["skipped_files"] = skipped
+            vs_prev["skipped_reason"] = (
+                "pre-r04 timing methodology (per-rep blocks, no "
+                "chain/fetch anchoring) — not comparable"
+            )
         if prev is not None:
             vs_prev["prev_file"] = prev_name
 
@@ -650,8 +741,22 @@ def main():
                   prev_engine_s("dense18q", "n18"), False)
             delta("dense20q_fwd_grad_s", dense20.get("fwd_grad_s"),
                   prev_engine_s("dense20q", "n20"), False)
-            delta("time_to_target_s", (ttt or {}).get("seconds"),
-                  (prev.get("time_to_target") or {}).get("seconds"), False)
+            prev_ttt = prev.get("time_to_target") or {}
+            if prev_ttt.get("timing", "").startswith("hot"):
+                delta("time_to_target_s", (ttt or {}).get("seconds"),
+                      prev_ttt.get("seconds"), False)
+            else:
+                # Pre-r06 rows timed a cold first run (compile-cache
+                # state inside the window — the r05 "regression",
+                # docs/PERF.md §11); a hot-vs-cold ratio is methodology
+                # noise, not drift. Record, don't flag.
+                vs_prev["time_to_target_s"] = {
+                    "prev": prev_ttt.get("seconds"),
+                    "now": (ttt or {}).get("seconds"),
+                    "note": "prev is cold/first-touch (pre-r06 "
+                            "methodology) — not compared",
+                    "regressed": False,
+                }
     except Exception as e:  # noqa: BLE001 — tracking must never kill bench
         vs_prev["error"] = f"{type(e).__name__}: {e}"
 
@@ -676,6 +781,7 @@ def main():
         "dense20q_bf16": dense20_bf16,
         "fed16q": fed16,
         "fed16q_bf16": fed16_bf16,
+        "fed16q_bf16_unfolded": fed16_bf16_unfolded,
         "time_to_target": ttt,
         "time_to_target_20q": ttt20,
         "vs_prev": vs_prev,
@@ -720,6 +826,9 @@ def main():
                 "fed16q_client_rounds_per_s": {
                     "f32": fed16.get("client_rounds_per_s"),
                     "bf16": fed16_bf16.get("client_rounds_per_s"),
+                    "bf16_unfolded": fed16_bf16_unfolded.get(
+                        "client_rounds_per_s"
+                    ),
                 },
                 "time_to_target": ttt_brief(ttt),
                 "time_to_target_20q": ttt_brief(ttt20),

@@ -81,16 +81,12 @@ def hardware_efficient(
     return state
 
 
-def ansatz_layer_b(state, n_qubits: int, rx_angles, rz_angles):
-    """Batched-slab twin of ``ansatz_layer``: same circuit, state shape
-    (B, 2^n) with batch folded into slab rows (ops.batched — the layout
-    fix for scanned-batch training; docs/PERF.md §8)."""
-    from qfedx_tpu.ops.batched import apply_cnot_b, apply_gate_b
+def _entangle_ring_b(state, n_qubits: int):
+    """CNOT ring on the batched slab (the batch-folded ``_entangle_ring``);
+    CNOTs are coefficient-free so one form serves shared, per-sample and
+    per-client layers alike."""
+    from qfedx_tpu.ops.batched import apply_cnot_b
 
-    for q in range(n_qubits):
-        state = apply_gate_b(
-            state, n_qubits, gates.rot_zx(rx_angles[q], rz_angles[q]), q
-        )
     if n_qubits < 2:
         return state
     for q in range(n_qubits - 1):
@@ -98,6 +94,19 @@ def ansatz_layer_b(state, n_qubits: int, rx_angles, rz_angles):
     if n_qubits > 2:
         state = apply_cnot_b(state, n_qubits, n_qubits - 1, 0)
     return state
+
+
+def ansatz_layer_b(state, n_qubits: int, rx_angles, rz_angles):
+    """Batched-slab twin of ``ansatz_layer``: same circuit, state shape
+    (B, 2^n) with batch folded into slab rows (ops.batched — the layout
+    fix for scanned-batch training; docs/PERF.md §8)."""
+    from qfedx_tpu.ops.batched import apply_gate_b
+
+    for q in range(n_qubits):
+        state = apply_gate_b(
+            state, n_qubits, gates.rot_zx(rx_angles[q], rz_angles[q]), q
+        )
+    return _entangle_ring_b(state, n_qubits)
 
 
 def hardware_efficient_b(state, n_qubits: int, params: dict):
@@ -108,6 +117,63 @@ def hardware_efficient_b(state, n_qubits: int, params: dict):
     for layer in range(n_layers):
         state = ansatz_layer_b(
             state, n_qubits, params["rx"][layer], params["rz"][layer]
+        )
+    return state
+
+
+def ansatz_layer_cb(state, n_qubits: int, rx_angles, rz_angles):
+    """Client-folded ansatz layer: state (C·B, 2^n) with the CLIENT axis a
+    leading group of the slab rows, angles (C, n) — one grouped gate
+    (ops.batched per-group coefficients) per qubit instead of a client
+    vmap over C engine traces (docs/PERF.md §10)."""
+    from qfedx_tpu.ops.batched import apply_gate_b
+
+    for q in range(n_qubits):
+        state = apply_gate_b(
+            state,
+            n_qubits,
+            gates.rot_zx_batched(rx_angles[:, q], rz_angles[:, q]),
+            q,
+        )
+    return _entangle_ring_b(state, n_qubits)
+
+
+def hardware_efficient_cb(state, n_qubits: int, params: dict):
+    """Client-folded ``hardware_efficient``: params leaves carry a leading
+    client axis — {"rx": (C, L, n), "rz": (C, L, n)} — and the state is the
+    (C·B, 2^n) client-major slab."""
+    n_layers = params["rx"].shape[1]
+    for layer in range(n_layers):
+        state = ansatz_layer_cb(
+            state, n_qubits, params["rx"][:, layer], params["rz"][:, layer]
+        )
+    return state
+
+
+def data_reuploading_cb(features, params: dict):
+    """Client-folded ``data_reuploading``: features (C, B, n) in [0,1],
+    params leaves (C, L, n). Re-encoding angles depend on (client, sample,
+    qubit), so the encoder banks are per-sample gates over the C·B folded
+    rows; the variational layers are per-client grouped gates."""
+    from qfedx_tpu.ops.batched import apply_gate_b, bstate_product
+
+    c, b, n_qubits = features.shape
+    n_layers = params["rx"].shape[1]
+    for layer in range(n_layers):
+        angles = (
+            params["enc_w"][:, layer][:, None] * (features * jnp.pi)
+            + params["enc_b"][:, layer][:, None]
+        )  # (C, B, n)
+        flat = angles.reshape(c * b, n_qubits)
+        if layer == 0:
+            state = bstate_product(angle_amplitudes(flat, "ry"))
+        else:
+            for q in range(n_qubits):
+                state = apply_gate_b(
+                    state, n_qubits, gates.ry_batched(flat[:, q]), q
+                )
+        state = ansatz_layer_cb(
+            state, n_qubits, params["rx"][:, layer], params["rz"][:, layer]
         )
     return state
 

@@ -194,3 +194,115 @@ def make_local_update(model: Model, cfg: FedConfig) -> Callable:
         return delta, jnp.sum(mask), jnp.mean(epoch_losses)
 
     return local_update
+
+
+def make_local_update_clients(model: Model, cfg: FedConfig) -> Callable:
+    """Client-FOLDED local update: one traced program trains every client
+    of a device block at once.
+
+    The vmap form (``make_local_update`` under ``jax.vmap`` in fed.round)
+    composes a client batch axis over the whole scan/engine program; at
+    slab widths XLA demotes that axis on hundreds of state-sized
+    intermediates and the fed step pays ~1.5× over the fixed-batch floor
+    (docs/PERF.md §8). Here the client axis instead becomes the leading
+    GROUP of the batched slab (``model.apply_clients`` → ops.batched's
+    per-group gate coefficients), and the epoch/batch scans, optimizer
+    states and losses simply carry a leading client axis — per-client
+    math is unchanged because each client's loss depends only on its own
+    parameter slice.
+
+    Built ``local_update_c(global_params, x, y, mask, client_keys)`` takes
+    x [C, S, ...], y [C, S], mask [C, S], client_keys [C] (the SAME
+    ``fold_in(train_key, cid)`` keys the vmap path derives — PRNG parity)
+    and returns (delta, n_samples, mean_loss), each with leading client
+    axis C. Plain-gradient route only: SPSA and per-example DP keep the
+    vmap path (fed.round routes accordingly).
+    """
+    tx = make_optimizer(cfg)
+
+    def loss_fn(cparams, global_params, xb, yb, mb):
+        logits = model.apply_clients(cparams, xb)  # (C, Bb, K)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+        loss_c = jnp.sum(ce * mb, axis=1) / jnp.maximum(
+            jnp.sum(mb, axis=1), 1.0
+        )
+        if cfg.algorithm == "fedprox":
+            # Per-client proximal term: ‖θ_c − θ_global‖² summed over every
+            # leaf's non-client axes.
+            prox = sum(
+                jnp.sum(
+                    jnp.square(cp - gp),
+                    axis=tuple(range(1, cp.ndim)),
+                )
+                for cp, gp in zip(
+                    jax.tree.leaves(cparams), jax.tree.leaves(global_params)
+                )
+            )
+            loss_c = loss_c + 0.5 * cfg.prox_mu * prox
+        # Σ_c loss_c: each client's gradient lands in its own parameter
+        # slice (cross-client terms are identically zero).
+        return jnp.sum(loss_c), loss_c
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def local_update_c(global_params, x, y, mask, client_keys):
+        x, y, mask = jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask)
+        c, s = x.shape[0], x.shape[1]
+        if s % cfg.batch_size != 0:
+            raise ValueError(
+                f"padded client size {s} not a multiple of batch {cfg.batch_size}"
+            )
+        n_batches = s // cfg.batch_size
+        cparams = jax.tree.map(
+            lambda p: jnp.broadcast_to(p[None], (c,) + p.shape), global_params
+        )
+        opt_state = tx.init(cparams)
+
+        def epoch_body(carry, ekeys):  # ekeys: (C,) per-client epoch keys
+            cparams, opt_state = carry
+            split2 = jax.vmap(jax.random.split)(ekeys)
+            k_perm = split2[:, 0]
+            perms = jax.vmap(lambda k: jax.random.permutation(k, s))(k_perm)
+
+            def shuffle(a):  # (C, S, ...) → (nb, C, Bb, ...)
+                g = jax.vmap(lambda ai, p: ai[p])(a, perms)
+                g = g.reshape((c, n_batches, cfg.batch_size) + a.shape[2:])
+                return jnp.moveaxis(g, 1, 0)
+
+            xs, ys, ms = shuffle(x), shuffle(y), shuffle(mask)
+
+            def batch_body(carry, batch):
+                cparams, opt_state = carry
+                xb, yb, mb = batch
+                (_, loss_c), grads = grad_fn(
+                    cparams, global_params, xb, yb, mb
+                )
+                updates, opt_state = tx.update(grads, opt_state, cparams)
+                cparams = optax.apply_updates(cparams, updates)
+                return (cparams, opt_state), loss_c
+
+            (cparams, opt_state), losses = jax.lax.scan(
+                batch_body, (cparams, opt_state), (xs, ys, ms)
+            )
+            return (cparams, opt_state), jnp.mean(losses, axis=0)
+
+        # Key layout parity with the vmap path: per client, split(key, E)
+        # then per-epoch split(epoch_key) → (k_perm, k_drop); k_drop only
+        # feeds apply_train streams, which this route excludes.
+        epoch_keys = jnp.swapaxes(
+            jax.vmap(lambda k: jax.random.split(k, cfg.local_epochs))(
+                client_keys
+            ),
+            0,
+            1,
+        )
+        (cparams, _), epoch_losses = jax.lax.scan(
+            epoch_body, (cparams, opt_state), epoch_keys
+        )
+        delta = model.wrap_delta(
+            jax.tree.map(lambda cp, gp: cp - gp[None], cparams, global_params)
+        )
+        return delta, jnp.sum(mask, axis=1), jnp.mean(epoch_losses, axis=0)
+
+    return local_update_c
+

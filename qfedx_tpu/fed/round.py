@@ -8,9 +8,13 @@ state_dicts on host). Here one round is ONE jitted ``shard_map`` program
 over a ``clients`` mesh axis:
 
     per device (in parallel over ICI-connected chips):
-      vmap over its block of clients:
-        local training (lax.scan epochs × batches)        — compute
-        Δθ wrap → DP clip+noise → secure-agg mask          — privacy
+      its block of clients, FOLDED into one engine batch   — compute
+        (client-major (C·B, 2^n) slab + per-client gate
+         coefficients — fold_clients_enabled; vmap-over-
+         clients fallback for SPSA / per-example DP /
+         models without apply_clients)
+        local training (lax.scan epochs × batches)
+      per client: Δθ wrap → DP clip+noise → SA mask        — privacy
       weighted block-sum of masked updates                 — local reduce
     lax.psum over the clients axis                         — "the upload"
     θ_new = θ + Σ wΔ / Σ w  (computed replicated)          — "the broadcast"
@@ -24,6 +28,7 @@ one scalar — the MB/round metric the roadmap wants tracked
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple
 
 import jax
@@ -31,19 +36,52 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from qfedx_tpu.fed.client import make_local_update
+from qfedx_tpu.fed.client import make_local_update, make_local_update_clients
 from qfedx_tpu.fed.config import FedConfig
 from qfedx_tpu.fed.privacy import privatize
 from qfedx_tpu.fed.sampling import participation_mask
 from qfedx_tpu.fed.secure_agg import client_mask, ring_mask
 from qfedx_tpu.models.api import Model
 from qfedx_tpu.utils import trees
+from qfedx_tpu.utils.compat import shard_map
 
 
 class RoundStats(NamedTuple):
     mean_loss: jax.Array  # participation-weighted mean local loss
     total_weight: jax.Array  # Σ aggregation weights (0 ⇒ round was a no-op)
     num_participants: jax.Array
+
+
+def fold_clients_enabled(model: Model, cfg: FedConfig) -> bool:
+    """Fold the client axis into the engine batch instead of vmapping the
+    local update over C clients?
+
+    Folding is the r06 lever on the fed composition tax (docs/PERF.md
+    §8/§10): the client axis becomes the leading group of the batched
+    slab via ``model.apply_clients`` + per-client gate coefficients, one
+    engine trace instead of C. Eligible whenever the model supports it
+    and the config stays on the plain value_and_grad route — SPSA and
+    per-example DP carry per-client PRNG streams through the gradient
+    estimator itself and keep the vmap path; client-mode DP, secure
+    aggregation and sampling are delta post-processing and compose with
+    either path. QFEDX_FOLD_CLIENTS=0/1 pins the choice for eligible
+    configs (parity tests run both); like the engine env knobs it is read
+    at build time — set it before ``make_fed_round``.
+    """
+    eligible = (
+        model.apply_clients is not None
+        and model.apply_train is None
+        and cfg.optimizer != "spsa"
+        and not (cfg.dp is not None and cfg.dp.mode == "example")
+    )
+    env = os.environ.get("QFEDX_FOLD_CLIENTS")
+    if env is not None:
+        if env not in ("0", "1"):
+            raise ValueError(
+                f"QFEDX_FOLD_CLIENTS={env!r}: expected '0' or '1'"
+            )
+        return eligible and env == "1"
+    return eligible
 
 
 def make_fed_round(
@@ -60,6 +98,10 @@ def make_fed_round(
     device — SURVEY.md §7.3.5's inner vmap over a client block).
     """
     local_update = make_local_update(model, cfg)
+    folded = fold_clients_enabled(model, cfg)
+    local_update_c = (
+        make_local_update_clients(model, cfg) if folded else None
+    )
     axis_size = mesh.shape[axis]
     if num_clients % axis_size != 0:
         raise ValueError(
@@ -77,10 +119,10 @@ def make_fed_round(
         dp_key = jax.random.fold_in(round_key, 0xD9)
         sa_key = jax.random.fold_in(round_key, 0x5EC)
 
-        def run_client(cid, x, y, m):
-            delta, n, loss = local_update(
-                params, x, y, m, jax.random.fold_in(train_key, cid)
-            )
+        def postprocess(cid, delta, n, loss):
+            """Privacy/masking/weighting of ONE client's finished update —
+            shared verbatim between the folded and vmap paths (always
+            vmapped: param-sized trees, no slab states)."""
             if cfg.dp is not None:
                 if cfg.dp.mode == "client":
                     delta = privatize(
@@ -111,7 +153,28 @@ def make_fed_round(
                 contrib = trees.tree_add(contrib, mask)
             return contrib, weight, loss
 
-        contribs, weights, losses = jax.vmap(run_client)(client_ids, cx, cy, cmask)
+        if folded:
+            # Client axis folded into the engine batch: the whole block's
+            # local training is ONE program (same per-client keys as the
+            # vmap path — fold_in(train_key, cid)).
+            ckeys = jax.vmap(
+                lambda c: jax.random.fold_in(train_key, c)
+            )(client_ids)
+            deltas, ns, losses_c = local_update_c(params, cx, cy, cmask, ckeys)
+            contribs, weights, losses = jax.vmap(postprocess)(
+                client_ids, deltas, ns, losses_c
+            )
+        else:
+
+            def run_client(cid, x, y, m):
+                delta, n, loss = local_update(
+                    params, x, y, m, jax.random.fold_in(train_key, cid)
+                )
+                return postprocess(cid, delta, n, loss)
+
+            contribs, weights, losses = jax.vmap(run_client)(
+                client_ids, cx, cy, cmask
+            )
 
         # Reduce the local client block, then all-reduce across chips.
         block_sum = jax.tree.map(lambda t: jnp.sum(t, axis=0), contribs)
@@ -131,7 +194,7 @@ def make_fed_round(
         )
         return new_params, stats
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         per_device,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P(axis), P()),

@@ -41,6 +41,13 @@ class Model:
     # Optional stochastic forward for local training (e.g. dropout):
     # (params, x, key) -> logits. Falls back to ``apply`` when None.
     apply_train: Callable[[Params, jax.Array, jax.Array], jax.Array] | None = None
+    # Optional client-folded forward: (cparams, x) -> logits where every
+    # params leaf carries a leading client axis C and x is [C, B, ...] →
+    # [C, B, K]. The federated round folds diverged per-client parameters
+    # into the engine's batch through this instead of vmapping ``apply``
+    # over C traces (fed.round; docs/PERF.md §10). None → the round keeps
+    # the vmap path.
+    apply_clients: Callable[[Params, jax.Array], jax.Array] | None = None
     # Mesh requirements. A model whose ``apply`` contains collectives (the
     # sv-sharded VQC) sets sv_size > 1: callers must trace it inside a
     # shard_map over a mesh carrying ``sv_axis`` of that size (the trainer

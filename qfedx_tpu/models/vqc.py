@@ -178,6 +178,50 @@ def make_vqc_classifier(
 
         return jax.vmap(one)(x)
 
+    def _apply_batched_clients(cparams, x):
+        """Client-folded forward: params leaves (C, …), x (C, B, feat) —
+        the C clients' states run as ONE (C·B, 2^n) slab with per-client
+        grouped gate coefficients (ops.batched; docs/PERF.md §10)."""
+        from qfedx_tpu.circuits.ansatz import (
+            data_reuploading_cb,
+            hardware_efficient_cb,
+        )
+        from qfedx_tpu.circuits.encoders import angle_amplitudes
+        from qfedx_tpu.ops.batched import (
+            bstate_amplitude,
+            bstate_product,
+            expect_z_all_b,
+        )
+        from qfedx_tpu.ops.cpx import state_dtype
+
+        c, bsz = x.shape[0], x.shape[1]
+        a = cparams["ansatz"]
+        if encoding == "reupload":
+            state = data_reuploading_cb(x, a)
+        else:
+            flat = x.reshape((c * bsz,) + x.shape[2:])
+            if encoding == "amplitude":
+                state = bstate_amplitude(flat, state_dtype())
+            else:
+                state = bstate_product(
+                    angle_amplitudes(flat * jnp.pi, basis)
+                )
+            state = hardware_efficient_cb(state, n_qubits, a)
+        k = cparams["readout"]["scale"].shape[-1]
+        z = expect_z_all_b(state, n_qubits)[:, :k].reshape(c, bsz, k)
+        return (
+            cparams["readout"]["scale"][:, None, :] * z
+            + cparams["readout"]["bias"][:, None, :]
+        )
+
+    def apply_clients(cparams, x):
+        # Same routing decision as ``apply``: the folded engine is a TPU
+        # layout fix; off-route (CPU, sub-slab widths, pins) the client
+        # axis rides vmap over the per-client ``apply`` — identical math.
+        if _use_batched():
+            return _apply_batched_clients(cparams, x)
+        return jax.vmap(apply)(cparams, x)
+
     if circuit_noise and encoding == "reupload":
         raise ValueError("circuit-level noise supports angle/amplitude encodings")
 
@@ -241,5 +285,6 @@ def make_vqc_classifier(
         apply=apply,
         wrap_delta=wrap_delta,
         apply_train=apply_train,
+        apply_clients=apply_clients,
         name=f"vqc{n_qubits}q{n_layers}l-{encoding}",
     )

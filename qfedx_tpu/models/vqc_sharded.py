@@ -36,6 +36,7 @@ from qfedx_tpu.models.api import Model
 from qfedx_tpu.models.vqc import wrap_angle
 from qfedx_tpu.parallel.circuit import sharded_hea_state
 from qfedx_tpu.parallel.sharded import ShardCtx, expect_z_all_sharded, pmean_grad
+from qfedx_tpu.utils.compat import shard_map
 
 
 def make_sharded_vqc_classifier(
@@ -150,6 +151,10 @@ def make_sharded_vqc_classifier(
         apply=apply,
         wrap_delta=wrap_delta,
         apply_train=apply_train,
+        # No apply_clients: the sv engine's per-qubit ppermute choreography
+        # has no client-grouped form, so the fed round keeps the vmap
+        # client path for sharded models (parallel.sharded module doc).
+        apply_clients=None,
         name=f"svqc{n_qubits}q{n_layers}l-{encoding}-sv{sv_size}",
         sv_size=sv_size,
         sv_axis=sv_axis,
@@ -166,7 +171,7 @@ def host_apply(model: Model, mesh: Mesh, sv_axis: str = "sv"):
     """
 
     def wrapped(params, x):
-        return jax.shard_map(
+        return shard_map(
             model.apply,
             mesh=mesh,
             in_specs=(P(), P()),

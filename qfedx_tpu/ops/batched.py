@@ -18,9 +18,18 @@ same scanned harness.
 This module is the batched twin of ``ops.statevector``'s slab path (same
 row/lane split, same structured-matmul lane gates, same flip/select row
 gates — see the design rationale there); ``models.vqc`` routes whole-batch
-applies here at slab widths. Per-sample gates (data reuploading: one
-rotation *per sample* per qubit) keep the batch axis separate only inside
-the affected view — shared-coefficient gates always run batch-folded.
+applies here at slab widths. Gate coefficients come in three forms:
+
+- shared ``(2,2)`` — one gate for the whole batch, fully batch-folded;
+- grouped ``(G,2,2)`` with G | B — the batch is G contiguous groups of
+  S = B/G rows and group g's coefficients apply to all of its rows. This
+  is the per-CLIENT form of the folded federated path (docs/PERF.md §10):
+  C diverged clients × S samples run as one (C·S, 2^n) slab, client c's
+  rotation coefficients indexed per group — one engine trace instead of a
+  ``jax.vmap`` over C traces (the residual ~1.5× composition tax §8
+  measured on the fed path);
+- per-sample, the G == B special case of grouped (the data-reuploading
+  encoder banks: one rotation per sample per qubit).
 
 Capability anchor: reference src/QFed/qAmplitude.py:44-46 is the simulator
 being replaced; reference ROADMAP.md:86 names 20 qubits as the dense
@@ -115,38 +124,45 @@ def bstate_amplitude(x: jnp.ndarray, dtype) -> CArray:
     for all-zero rows (reference qAmplitude.py:17-21), batched."""
     x = jnp.asarray(x, dtype=jnp.float32)
     size = x.shape[-1]
+    n = size.bit_length() - 1
+    if size <= 0 or (1 << n) != size:
+        # Mirror circuits.encoders.amplitude_encode's validation: without
+        # it a wrong feature count surfaces as an opaque reshape error
+        # deep inside apply_gate_b (ADVICE r05).
+        raise ValueError(f"amplitude encoding needs 2^n features, got {size}")
     norm = jnp.linalg.norm(x, axis=-1, keepdims=True)
     uniform = jnp.full_like(x, 1.0 / jnp.sqrt(size))
     safe = jnp.where(norm > 0, x / jnp.where(norm > 0, norm, 1.0), uniform)
     return CArray(safe.astype(dtype), None)
 
 
-def _row_view(s: jnp.ndarray, b: int, n: int, qubit: int, fold: bool):
-    """(B·a, 2, c, 128) view (fold=True, shared gates) or (B, a, 2, c, 128)
-    (fold=False, per-sample gates) splitting the row index at ``qubit``."""
+def _row_view(s: jnp.ndarray, b: int, n: int, qubit: int,
+              groups: int | None):
+    """Row view splitting the row index at ``qubit``: (B·a, 2, c, 128)
+    for shared gates (groups=None) or (G, S·a, 2, c, 128) for grouped
+    coefficients (B = G·S, group-major rows — per-sample is G = B)."""
     a = 1 << qubit
     c = 1 << (n - _LANE_BITS - qubit - 1)
-    if fold:
+    if groups is None:
         return s.reshape(b * a, 2, c, _LANES)
-    return s.reshape(b, a, 2, c, _LANES)
+    return s.reshape(groups, (b // groups) * a, 2, c, _LANES)
 
 
-def _diag_coeffs(gre, gim, per_sample: bool, fold: bool):
+def _diag_coeffs(gre, gim, groups: int | None):
     """Diagonal/off-diagonal gate coefficients broadcast for the row view.
 
     Shared gate (2,2): shapes (1,2,1,1) against (B·a,2,c,128).
-    Per-sample gate (B,2,2): shapes (B,1,2,1,1) against (B,a,2,c,128).
+    Grouped gate (G,2,2): shapes (G,1,2,1,1) against (G,S·a,2,c,128).
     """
     idx = jnp.arange(2)
-    if per_sample:
-        assert not fold
+    if groups is not None:
         shp = (-1, 1, 2, 1, 1)
         ud_re = gre[:, idx, idx].reshape(shp)
         uo_re = gre[:, idx, 1 - idx].reshape(shp)
         ud_im = None if gim is None else gim[:, idx, idx].reshape(shp)
         uo_im = None if gim is None else gim[:, idx, 1 - idx].reshape(shp)
     else:
-        shp = (1, 2, 1, 1) if fold else (1, 1, 2, 1, 1)
+        shp = (1, 2, 1, 1)
         ud_re = gre[idx, idx].reshape(shp)
         uo_re = gre[idx, 1 - idx].reshape(shp)
         ud_im = None if gim is None else gim[idx, idx].reshape(shp)
@@ -155,17 +171,16 @@ def _diag_coeffs(gre, gim, per_sample: bool, fold: bool):
 
 
 def _row_gate(state: CArray, b: int, n: int, gate: CArray, qubit: int,
-              per_sample: bool) -> CArray:
+              groups: int | None) -> CArray:
     """Row-qubit gate in flip/select form on the batched slab."""
     dtype = state.re.dtype
     gre, gim = _cast_parts(gate, dtype)
-    fold = not per_sample
-    axis = 1 if fold else 2
-    ud_re, uo_re, ud_im, uo_im = _diag_coeffs(gre, gim, per_sample, fold)
+    axis = 1 if groups is None else 2
+    ud_re, uo_re, ud_im, uo_im = _diag_coeffs(gre, gim, groups)
     shape = state.re.shape
 
     def view(s):
-        return _row_view(s, b, n, qubit, fold)
+        return _row_view(s, b, n, qubit, groups)
 
     def lin(ud, uo, v, f):
         return ud * v + uo * f
@@ -197,13 +212,15 @@ def _row_gate(state: CArray, b: int, n: int, gate: CArray, qubit: int,
 
 
 def _lane_matmul(state: CArray, b: int, mt_re, mt_im,
-                 per_sample: bool) -> CArray:
-    """s @ Mt on the (…, 128) lane dim; per-sample uses a batched matmul
-    (B, R, 128) × (B, 128, 128) on the MXU."""
+                 groups: int | None) -> CArray:
+    """s @ Mt on the (…, 128) lane dim; grouped coefficients use a batched
+    matmul (G, S·R, 128) × (G, 128, 128) on the MXU (per-sample: G = B)."""
     shape = state.re.shape
-    if per_sample:
+    if groups is not None:
         def mm(s, m):
-            return jnp.einsum("brl,blk->brk", s.reshape(b, -1, _LANES), m)
+            return jnp.einsum(
+                "grl,glk->grk", s.reshape(groups, -1, _LANES), m
+            )
     else:
         def mm(s, m):
             return s.reshape(-1, _LANES) @ m
@@ -224,24 +241,31 @@ def _lane_matmul(state: CArray, b: int, mt_re, mt_im,
 def apply_gate_b(state: CArray, n: int, gate: CArray, qubit: int) -> CArray:
     """Apply a 1-qubit gate to a batched (B, 2^n) state.
 
-    ``gate``: (2,2) CArray shared across the batch, or (B,2,2) per-sample
-    (the data-reuploading encoder banks). Requires n ≥ _SLAB_MIN.
+    ``gate``: (2,2) CArray shared across the batch, or (G,2,2) grouped
+    with G dividing B — group g's coefficients apply to its contiguous
+    block of B/G rows (per-CLIENT gates of the folded federated path;
+    G == B is the per-sample form of the data-reuploading encoder
+    banks). Requires n ≥ _SLAB_MIN.
     """
     if n < _SLAB_MIN:
         raise ValueError(f"batched engine needs n ≥ {_SLAB_MIN}, got {n}")
     b = state.re.shape[0]
-    per_sample = gate.re.ndim == 3
+    groups = None
+    if gate.re.ndim == 3:
+        groups = gate.re.shape[0]
+        if groups <= 0 or b % groups != 0:
+            raise ValueError(
+                f"grouped gate has {groups} coefficient groups but the "
+                f"batch is {b} rows — G must divide B"
+            )
     dtype = state.re.dtype
     if qubit >= n - _LANE_BITS:  # lane qubit → structured matmul
         gre, gim = _cast_parts(gate, dtype)
         p = _slab_pos(n, qubit)
-        mt = jax.vmap(lambda g: _lane_mt(g, p)) if per_sample else (
-            lambda g: _lane_mt(g, p)
-        )
-        mt_re = mt(gre)
-        mt_im = None if gim is None else mt(gim)
-        return _lane_matmul(state, b, mt_re, mt_im, per_sample)
-    return _row_gate(state, b, n, gate, qubit, per_sample)
+        mt_re = _lane_mt(gre, p)  # broadcasts leading group axes
+        mt_im = None if gim is None else _lane_mt(gim, p)
+        return _lane_matmul(state, b, mt_re, mt_im, groups)
+    return _row_gate(state, b, n, gate, qubit, groups)
 
 
 def apply_cnot_b(state: CArray, n: int, ctrl: int, tgt: int) -> CArray:
@@ -281,7 +305,7 @@ def apply_cnot_b(state: CArray, n: int, ctrl: int, tgt: int) -> CArray:
         p = _lane_perm_flip(_slab_pos(n, tgt), dtype)
 
         def one(s):
-            v = _row_view(s, b, n, ctrl, fold=True)
+            v = _row_view(s, b, n, ctrl, groups=None)
             return jnp.where(mask, v @ p, v).reshape(shape)
 
         return _cmap(state, one)
@@ -293,7 +317,7 @@ def apply_cnot_b(state: CArray, n: int, ctrl: int, tgt: int) -> CArray:
     mask = (lane_bit == 1).reshape(1, 1, 1, _LANES)
 
     def one(s):
-        v = _row_view(s, b, n, tgt, fold=True)
+        v = _row_view(s, b, n, tgt, groups=None)
         return jnp.where(mask, jnp.flip(v, 1), v).reshape(shape)
 
     return _cmap(state, one)

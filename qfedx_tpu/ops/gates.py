@@ -103,6 +103,31 @@ def ry_batched(theta) -> CArray:
     return CArray(re, None)
 
 
+def rot_zx_batched(theta, phi) -> CArray:
+    """RZ(φ)·RX(θ) fused, per-group: angles (G,) → (G, 2, 2) CArray.
+
+    The per-client gate banks of the folded federated path
+    (ops.batched.apply_gate_b's grouped form): client g's coefficients are
+    broadcast over its block of slab rows, so C diverged clients ride ONE
+    engine trace instead of a vmap over C traces. Entry layout identical
+    to ``rot_zx``."""
+    theta = jnp.asarray(theta, dtype=RDTYPE)
+    phi = jnp.asarray(phi, dtype=RDTYPE)
+    c, s = jnp.cos(theta / 2), jnp.sin(theta / 2)
+    a, b = jnp.cos(phi / 2), jnp.sin(phi / 2)
+    re = jnp.stack(
+        [jnp.stack([a * c, -b * s], axis=-1),
+         jnp.stack([b * s, a * c], axis=-1)],
+        axis=-2,
+    )
+    im = jnp.stack(
+        [jnp.stack([-b * c, -a * s], axis=-1),
+         jnp.stack([-a * s, b * c], axis=-1)],
+        axis=-2,
+    )
+    return CArray(re, im)
+
+
 def rot_zx(theta, phi) -> CArray:
     """RZ(φ)·RX(θ) fused into one 2×2 gate.
 

@@ -325,16 +325,25 @@ def _lane_iota():
 
 
 def _lane_mt(part: jnp.ndarray, p: int) -> jnp.ndarray:
-    """(128,128) Mt with (s @ Mt) applying the 2×2 ``part`` on lane bit p:
-    Mt[j,l] = part[bit_l(p), bit_j(p)] where all other bits of j,l agree."""
+    """(…,128,128) Mt with (s @ Mt) applying the 2×2 ``part`` on lane bit p:
+    Mt[j,l] = part[bit_l(p), bit_j(p)] where all other bits of j,l agree.
+
+    ``part`` may carry leading batch axes (…,2,2) — the batched engine's
+    per-sample and per-client gate stacks (ops.batched) build their
+    (G,128,128) lane matrices through this same broadcast instead of a
+    vmap trace around the scalar form."""
     j, l = _lane_iota()
     other_ok = ((j ^ l) & (_LANES - 1 - (1 << p))) == 0
     bj = (j >> p) & 1
     bl = (l >> p) & 1
+
+    def elem(r, c):
+        return part[..., r, c][..., None, None]
+
     val = jnp.where(
         bl == 0,
-        jnp.where(bj == 0, part[0, 0], part[0, 1]),
-        jnp.where(bj == 0, part[1, 0], part[1, 1]),
+        jnp.where(bj == 0, elem(0, 0), elem(0, 1)),
+        jnp.where(bj == 0, elem(1, 0), elem(1, 1)),
     )
     return jnp.where(other_ok, val, jnp.zeros((), dtype=part.dtype))
 

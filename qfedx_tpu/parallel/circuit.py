@@ -26,6 +26,7 @@ from qfedx_tpu.parallel.sharded import (
     expect_z_all_sharded,
     product_state_local,
 )
+from qfedx_tpu.utils.compat import shard_map
 
 
 def sharded_encoded_state(ctx: ShardCtx, features: jnp.ndarray, encoding: str):
@@ -98,7 +99,7 @@ def make_sharded_forward(
         state = sharded_hea_state(ctx, x, params)
         return expect_z_all_sharded(ctx, state)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         per_device,
         mesh=mesh,
         in_specs=(P(), P()),

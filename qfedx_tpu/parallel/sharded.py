@@ -17,6 +17,17 @@ All functions here run INSIDE ``shard_map`` over the state axis and take a
 single-chip qubit ceiling by 3 (e.g. 20-qubit dense → 23-qubit sharded on
 the same HBM).
 
+Relation to the OTHER parallel axis (r06): the federated round shards
+*clients* over a mesh axis and, for single-chip models, folds each
+device's client block into the batched slab engine as a client-major
+group dimension (fed.round fold_clients_enabled → ops.batched's
+per-group gate coefficients) — the ``(C·B, 2^n)`` slab travels through
+``shard_map`` exactly like any other per-device value. This engine is
+the orthogonal case: ONE state too big for a chip, amplitudes sharded
+over ``sv``. Its per-qubit ppermute choreography has no batched twin, so
+sharded-VQC models keep ``apply_clients=None`` and the fed round's vmap
+client path (models.vqc_sharded).
+
 Device-bit convention: device index i = Σ_q bit_q << (d-1-q) — qubit 0 is
 the most-significant device bit, matching axis-0-major flattening of the
 dense (2,)*n tensor, so dense↔sharded round-trips are pure reshapes.

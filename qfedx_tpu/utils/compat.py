@@ -1,0 +1,49 @@
+"""JAX version compatibility shims.
+
+The framework targets the current TPU toolchain, where ``jax.shard_map``
+is a public top-level API with a ``check_vma`` flag. Older jax releases
+(< 0.5) ship the same transform as ``jax.experimental.shard_map.shard_map``
+with the flag spelled ``check_rep``. Every shard_map in the codebase goes
+through this one wrapper so the whole SPMD layer (fed round, sharded
+statevector, sharded VQC) runs on both toolchains — in particular on CPU
+test environments pinned to an older jax, where the top-level name simply
+not existing used to fail the entire federated test surface at import
+time.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _check_kwarg(fn) -> str:
+    """Which replication-check kwarg ``fn`` takes: the top-level promotion
+    of shard_map and the check_rep → check_vma rename landed in different
+    jax releases, so the spelling must be read off the signature, not
+    inferred from where the function lives."""
+    import inspect
+
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):  # C-accelerated / wrapped: assume new
+        return "check_vma"
+    return "check_vma" if "check_vma" in params else "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` on new jax, ``jax.experimental.shard_map`` on old.
+
+    Same semantics either way; ``check_vma`` maps onto the old API's
+    ``check_rep`` (both gate the replication/varying-manual-axes check).
+    """
+    if hasattr(jax, "shard_map"):
+        sm = jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{_check_kwarg(sm): check_vma},
+    )

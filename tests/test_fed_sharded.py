@@ -19,6 +19,7 @@ from qfedx_tpu.models.vqc_sharded import (
     host_apply,
     make_sharded_vqc_classifier,
 )
+from qfedx_tpu.utils.compat import shard_map
 
 N_QUBITS = 5  # 2 global (sv=4), 3 local
 
@@ -82,6 +83,7 @@ def test_fed_round_2d_matches_dense_1d(mesh2d, models):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_fed_round_2d_converges(mesh2d, models):
     """Multi-round training on the 2-D mesh drives the loss down."""
     _, sharded = models
@@ -141,6 +143,7 @@ def test_sharded_readout_noise_matches_dense(mesh2d):
     np.testing.assert_allclose(got, want, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_sharded_trajectory_noise_matches_dense_sample_for_sample(mesh2d):
     """Circuit-level Kraus trajectories: the sharded engine computes global
     branch norms (psum) and samples with the replicated key using the dense
@@ -162,7 +165,7 @@ def test_sharded_trajectory_noise_matches_dense_sample_for_sample(mesh2d):
     from jax.sharding import PartitionSpec as P
 
     sh_fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             sharded.apply_train,
             mesh=mesh2d,
             in_specs=(P(), P(), P()),
@@ -175,6 +178,7 @@ def test_sharded_trajectory_noise_matches_dense_sample_for_sample(mesh2d):
     np.testing.assert_allclose(got, want, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_sharded_shots_train_matches_dense(mesh2d):
     """Finite-shot training noise: replicated key ⇒ identical binomial
     draws on sharded and dense paths."""
@@ -193,7 +197,7 @@ def test_sharded_shots_train_matches_dense(mesh2d):
     )
     key = jax.random.PRNGKey(21)
     sh_fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             sharded.apply_train,
             mesh=mesh2d,
             in_specs=(P(), P(), P()),
@@ -210,6 +214,7 @@ def test_sharded_shots_train_matches_dense(mesh2d):
     np.testing.assert_allclose(e1, e2)
 
 
+@pytest.mark.slow
 def test_cli_sv_size_trains_end_to_end(tmp_path):
     """VERDICT round-1 item 2 criterion: the CLI-built sharded path —
     ``train --model vqc --qubits 8 --sv-size 4`` — runs on the 8-device

@@ -1,0 +1,371 @@
+"""The folded client axis ≡ the vmap client path (r06 tentpole).
+
+Three layers of parity, each pinning the fold at a different altitude:
+
+- ops: grouped (G,2,2) gate coefficients on a (G·S, 2^n) slab ≡ a
+  per-client vmap of the dense engine (row and lane qubits);
+- model: ``apply_clients`` with the batched slab engine pinned ≡ a vmap
+  of ``apply`` over diverged per-client params — logits AND gradients,
+  f32 and bf16 tolerances;
+- round: ``make_fed_round`` / ``make_fed_rounds`` with the fold pinned on
+  ≡ pinned off (QFEDX_FOLD_CLIENTS), on the 8-device virtual mesh.
+
+Also documents the r05 time_to_target finding: the batched auto-route is
+gated on _SLAB_MIN and can NOT engage at the flagship 8-qubit shape, so
+the suspected routing change is exonerated by construction (bench.py /
+docs/PERF.md §11 for the real mechanism).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qfedx_tpu.ops import gates
+from qfedx_tpu.ops.batched import apply_gate_b, batched_enabled
+from qfedx_tpu.ops.cpx import CArray
+from qfedx_tpu.ops.statevector import apply_gate
+
+N = 10  # smallest slab width (statevector._SLAB_MIN)
+G, S = 3, 2  # client groups × samples per client
+B = G * S
+
+
+def _rand_state(seed: int) -> CArray:
+    rng = np.random.default_rng(seed)
+    re = jnp.asarray(rng.standard_normal((B, 1 << N)), dtype=jnp.float32)
+    im = jnp.asarray(rng.standard_normal((B, 1 << N)), dtype=jnp.float32)
+    return CArray(re, im)
+
+
+@pytest.mark.parametrize("qubit", [0, 2, N - 7, N - 2, N - 1])  # row + lane
+def test_grouped_gate_parity(qubit):
+    """(G,2,2) grouped coefficients ≡ per-group vmap of the dense engine."""
+    state = _rand_state(0)
+    th = jnp.asarray([0.3, -1.2, 2.5], dtype=jnp.float32)
+    ph = jnp.asarray([0.9, 0.1, -0.7], dtype=jnp.float32)
+    out = apply_gate_b(state, N, gates.rot_zx_batched(th, ph), qubit)
+
+    tens_re = state.re.reshape((G, S) + (2,) * N)
+    tens_im = state.im.reshape((G, S) + (2,) * N)
+
+    def one(s_re, s_im, t, p):
+        o = apply_gate(CArray(s_re, s_im), gates.rot_zx(t, p), qubit)
+        return o.re, o.im
+
+    ref_re, ref_im = jax.vmap(
+        jax.vmap(one, in_axes=(0, 0, None, None)), in_axes=(0, 0, 0, 0)
+    )(tens_re, tens_im, th, ph)
+    np.testing.assert_allclose(
+        np.asarray(out.re), np.asarray(ref_re).reshape(B, -1), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.im), np.asarray(ref_im).reshape(B, -1), atol=1e-5
+    )
+
+
+def test_grouped_gate_rejects_nondivisor_groups():
+    state = _rand_state(1)
+    bad = gates.rot_zx_batched(jnp.zeros(4), jnp.zeros(4))  # 4 ∤ 6
+    with pytest.raises(ValueError, match="G must divide B"):
+        apply_gate_b(state, N, bad, 0)
+
+
+def test_bstate_amplitude_rejects_non_pow2():
+    """The batched route fails with the same clear ValueError as
+    circuits.encoders.amplitude_encode (ADVICE r05), not a reshape error."""
+    from qfedx_tpu.ops.batched import bstate_amplitude
+
+    with pytest.raises(ValueError, match="2\\^n features"):
+        bstate_amplitude(jnp.zeros((2, 1000)), jnp.float32)
+
+
+def test_batched_route_cannot_engage_below_slab(monkeypatch):
+    """The r05 time_to_target suspect (models/vqc.py batched auto-route at
+    the flagship 8-qubit shape) is impossible by construction: the route
+    gates on _SLAB_MIN before reading any pin."""
+    monkeypatch.setenv("QFEDX_BATCHED", "1")
+    assert batched_enabled(8) is False
+
+
+def _diverged_cparams(model, c):
+    p0 = model.init(jax.random.PRNGKey(0))
+    return jax.tree.map(
+        lambda p: p[None]
+        * (1.0 + 0.1 * jnp.arange(c).reshape((c,) + (1,) * p.ndim)),
+        p0,
+    )
+
+
+@pytest.mark.parametrize("encoding", ["angle", "reupload"])
+def test_apply_clients_engine_parity(encoding, monkeypatch):
+    """Folded slab engine (per-client grouped gates) ≡ vmap of the
+    per-client apply: logits and gradients, diverged params."""
+    import optax
+
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    monkeypatch.setenv("QFEDX_BATCHED", "1")
+    c, bsz = 2, 2
+    model = make_vqc_classifier(
+        n_qubits=N, n_layers=1, num_classes=2, encoding=encoding
+    )
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.uniform(0, 1, (c, bsz, N)), dtype=jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, (c, bsz)), dtype=jnp.int32)
+    cparams = _diverged_cparams(model, c)
+
+    folded = model.apply_clients(cparams, x)
+    ref = jax.vmap(model.apply)(cparams, x)
+    np.testing.assert_allclose(
+        np.asarray(folded), np.asarray(ref), atol=1e-5, rtol=0
+    )
+
+    def loss(f):
+        def g(cp):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                f(cp, x), y
+            ).mean()
+
+        return g
+
+    g_fold = jax.grad(loss(model.apply_clients))(cparams)
+    g_ref = jax.grad(loss(lambda cp, xx: jax.vmap(model.apply)(cp, xx)))(
+        cparams
+    )
+    for a, b in zip(jax.tree.leaves(g_fold), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=0
+        )
+
+
+def test_apply_clients_engine_parity_bf16(monkeypatch):
+    """Same parity under QFEDX_DTYPE=bf16 — the folded and vmap routes run
+    the same bf16-state/f32-accumulate recipe, so they agree to bf16
+    rounding, and gradients stay finite and close."""
+    import optax
+
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    monkeypatch.setenv("QFEDX_BATCHED", "1")
+    monkeypatch.setenv("QFEDX_DTYPE", "bf16")
+    c, bsz = 2, 2
+    model = make_vqc_classifier(n_qubits=N, n_layers=1, num_classes=2)
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.uniform(0, 1, (c, bsz, N)), dtype=jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, (c, bsz)), dtype=jnp.int32)
+    cparams = _diverged_cparams(model, c)
+
+    folded = model.apply_clients(cparams, x)
+    ref = jax.vmap(model.apply)(cparams, x)
+    np.testing.assert_allclose(
+        np.asarray(folded), np.asarray(ref), atol=3e-2, rtol=0
+    )
+
+    def loss(f):
+        def g(cp):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                f(cp, x), y
+            ).mean()
+
+        return g
+
+    g_fold = jax.grad(loss(model.apply_clients))(cparams)
+    g_ref = jax.grad(loss(lambda cp, xx: jax.vmap(model.apply)(cp, xx)))(
+        cparams
+    )
+    for a, b in zip(jax.tree.leaves(g_fold), jax.tree.leaves(g_ref)):
+        a, b = np.asarray(a), np.asarray(b)
+        assert np.all(np.isfinite(a))
+        np.testing.assert_allclose(a, b, atol=3e-2, rtol=0)
+
+
+def _fed_data(num_clients=8, samples=8, n_q=3, seed=0):
+    rng = np.random.default_rng(seed)
+    cx = rng.uniform(0, 1, (num_clients, samples, n_q)).astype(np.float32)
+    cy = rng.integers(0, 2, (num_clients, samples)).astype(np.int32)
+    cm = np.ones((num_clients, samples), dtype=np.float32)
+    return cx, cy, cm
+
+
+@pytest.mark.parametrize(
+    "cfg_kwargs",
+    [
+        dict(optimizer="adam"),
+        dict(momentum=0.9),
+        dict(algorithm="fedprox", prox_mu=0.5),
+    ],
+    ids=["adam", "sgd-momentum", "fedprox"],
+)
+def test_fed_round_folded_matches_vmap(cfg_kwargs, monkeypatch):
+    """make_fed_round with the client fold pinned ON ≡ pinned OFF on the
+    8-device mesh (same keys, same math, different program structure)."""
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.fed.round import (
+        client_mesh,
+        fold_clients_enabled,
+        make_fed_round,
+        shard_client_data,
+    )
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    num_clients, samples, n_q = 8, 8, 3
+    cfg = FedConfig(
+        local_epochs=2, batch_size=4, learning_rate=0.1, **cfg_kwargs
+    )
+    mesh = client_mesh()
+    cx, cy, cm = _fed_data(num_clients, samples, n_q)
+    key = jax.random.PRNGKey(42)
+
+    results = {}
+    for pin in ("1", "0"):
+        monkeypatch.setenv("QFEDX_FOLD_CLIENTS", pin)
+        model = make_vqc_classifier(n_qubits=n_q, n_layers=2, num_classes=2)
+        assert fold_clients_enabled(model, cfg) is (pin == "1")
+        params = model.init(jax.random.PRNGKey(0))
+        rf = make_fed_round(model, cfg, mesh, num_clients=num_clients)
+        scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+        results[pin] = rf(params, scx, scy, scm, key)
+    p1, s1 = results["1"]
+    p0, s0 = results["0"]
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p0)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-6, rtol=0
+        )
+    np.testing.assert_allclose(
+        float(s1.mean_loss), float(s0.mean_loss), atol=1e-5
+    )
+    assert float(s1.total_weight) == float(s0.total_weight)
+
+
+def test_fed_round_folded_composes_privacy(monkeypatch):
+    """DP (client mode) + secure agg + sampling post-processing is shared
+    between the paths: folded ≡ vmap with everything on."""
+    from qfedx_tpu.fed.config import DPConfig, FedConfig
+    from qfedx_tpu.fed.round import (
+        client_mesh,
+        make_fed_round,
+        shard_client_data,
+    )
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    num_clients, samples, n_q = 8, 8, 3
+    cfg = FedConfig(
+        local_epochs=1,
+        batch_size=4,
+        learning_rate=0.1,
+        client_fraction=0.6,
+        dp=DPConfig(clip_norm=0.5, noise_multiplier=0.5),
+        secure_agg=True,
+    )
+    mesh = client_mesh()
+    cx, cy, cm = _fed_data(num_clients, samples, n_q, seed=2)
+    key = jax.random.PRNGKey(11)
+    results = {}
+    for pin in ("1", "0"):
+        monkeypatch.setenv("QFEDX_FOLD_CLIENTS", pin)
+        model = make_vqc_classifier(n_qubits=n_q, n_layers=1, num_classes=2)
+        params = model.init(jax.random.PRNGKey(0))
+        rf = make_fed_round(model, cfg, mesh, num_clients=num_clients)
+        scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+        results[pin] = rf(params, scx, scy, scm, key)
+    p1, s1 = results["1"]
+    p0, s0 = results["0"]
+    assert float(s1.num_participants) == float(s0.num_participants)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p0)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-6, rtol=0
+        )
+
+
+def test_fed_rounds_scanned_folded_on_mesh(monkeypatch):
+    """The folded path through make_fed_rounds (the trainer's scanned
+    dispatch) on the 8-device virtual mesh ≡ the same scan with the fold
+    pinned off, and ≡ sequential folded rounds (key-derivation parity)."""
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.fed.round import (
+        client_mesh,
+        make_fed_round,
+        make_fed_rounds,
+        shard_client_data,
+    )
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    num_clients, samples, n_q = 8, 8, 3
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.1, optimizer="adam"
+    )
+    mesh = client_mesh()
+    cx, cy, cm = _fed_data(num_clients, samples, n_q, seed=4)
+    base = jax.random.PRNGKey(7)
+
+    out = {}
+    for pin in ("1", "0"):
+        monkeypatch.setenv("QFEDX_FOLD_CLIENTS", pin)
+        model = make_vqc_classifier(n_qubits=n_q, n_layers=1, num_classes=2)
+        params0 = model.init(jax.random.PRNGKey(0))
+        scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+        chunk = make_fed_rounds(
+            model, cfg, mesh, num_clients=num_clients, rounds_per_call=3
+        )
+        out[pin] = chunk(params0, scx, scy, scm, base, 2)
+        if pin == "1":
+            # Sequential folded rounds with the trainer's fold_in(base, r)
+            # derivation must match the scan exactly.
+            one = make_fed_round(model, cfg, mesh, num_clients=num_clients)
+            p_seq = params0
+            for rnd in range(2, 5):
+                p_seq, _ = one(
+                    p_seq, scx, scy, scm, jax.random.fold_in(base, rnd)
+                )
+            for a, b in zip(
+                jax.tree.leaves(p_seq), jax.tree.leaves(out["1"][0])
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), atol=1e-6, rtol=0
+                )
+    for a, b in zip(jax.tree.leaves(out["1"][0]), jax.tree.leaves(out["0"][0])):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-6, rtol=0
+        )
+    np.testing.assert_allclose(
+        np.asarray(out["1"][1].mean_loss),
+        np.asarray(out["0"][1].mean_loss),
+        atol=1e-5,
+    )
+
+
+def test_fed_round_folded_slab_engine(monkeypatch):
+    """End-to-end at a SLAB width: the folded round with the batched
+    engine pinned (the TPU production composition: per-client grouped
+    gates inside shard_map) ≡ the vmap round, n=10 on the 8-device mesh
+    (~27 s on XLA:CPU — two n=10 local-update compiles)."""
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.fed.round import (
+        client_mesh,
+        make_fed_round,
+        shard_client_data,
+    )
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    monkeypatch.setenv("QFEDX_BATCHED", "1")
+    num_clients, samples, n_q = 8, 4, N
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1,
+                    momentum=0.0)
+    mesh = client_mesh()
+    cx, cy, cm = _fed_data(num_clients, samples, n_q, seed=6)
+    key = jax.random.PRNGKey(9)
+    results = {}
+    for pin in ("1", "0"):
+        monkeypatch.setenv("QFEDX_FOLD_CLIENTS", pin)
+        model = make_vqc_classifier(n_qubits=n_q, n_layers=1, num_classes=2)
+        params = model.init(jax.random.PRNGKey(0))
+        rf = make_fed_round(model, cfg, mesh, num_clients=num_clients)
+        scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+        results[pin] = rf(params, scx, scy, scm, key)
+    for a, b in zip(
+        jax.tree.leaves(results["1"][0]), jax.tree.leaves(results["0"][0])
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=0
+        )

@@ -227,7 +227,13 @@ def test_example_mode_trains_above_chance_single_digit_eps():
         local_epochs=1, batch_size=32, learning_rate=0.15, optimizer="adam",
         dp=DPConfig(clip_norm=0.5, noise_multiplier=3.0, mode="example"),
     )
+    # 16 rounds: at 8 rounds XLA:CPU (+ older jax) reductions leave this
+    # noisy trajectory collapsed onto one class (0.459 for every seed —
+    # the test-set class fraction) while it escapes by round 16 (0.918,
+    # ε = 2.6, measured); the contract — learns above chance at
+    # single-digit ε — is round-count-robust, so test where both
+    # backends' trajectories have converged.
     res = train_federated(model, cfg, cx, cy, cmask, *pre.test,
-                          num_rounds=8, seed=0, eval_every=8)
+                          num_rounds=16, seed=0, eval_every=16)
     assert res.final_accuracy > 0.7
     assert 0 < res.epsilons[-1] < 10.0

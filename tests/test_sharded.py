@@ -28,6 +28,7 @@ from qfedx_tpu.parallel import (
     swap_global_local,
     zero_state_local,
 )
+from qfedx_tpu.utils.compat import shard_map
 
 N_GLOBAL = 3  # 8 devices
 
@@ -44,7 +45,7 @@ def run_gathered(n_qubits, fn, *args):
         out = fn(ctx, *a)
         return out.re.reshape(1, -1), out.imag_or_zeros().reshape(1, -1)
 
-    f = jax.shard_map(
+    f = shard_map(
         per_device, mesh=mesh8(), in_specs=P(), out_specs=P("sv"), check_vma=False
     )
     re, im = f(*args)
@@ -55,7 +56,7 @@ def run_gathered(n_qubits, fn, *args):
 def run_scalar(n_qubits, fn, *args):
     """Run fn(ctx, *args) -> replicated array under shard_map."""
     ctx = ShardCtx("sv", n_qubits, N_GLOBAL)
-    f = jax.shard_map(
+    f = shard_map(
         lambda *a: fn(ctx, *a),
         mesh=mesh8(),
         in_specs=P(),
@@ -132,7 +133,10 @@ def test_swap_global_local(g, l):
         (0, 3),  # global control, local target
         (3, 0),  # local control, global target
         (0, 2),  # global-global
-        (2, 1),  # global-global reversed
+        # global-global reversed: same ppermute choreography as (0, 2)
+        # with the operand order flipped — ~17 s of XLA:CPU compile for a
+        # duplicate topology, kept out of the tier-1 gate budget.
+        pytest.param(2, 1, marks=pytest.mark.slow),
     ],
 )
 def test_cnot_everywhere(q1, q2):

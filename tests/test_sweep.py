@@ -19,6 +19,7 @@ def test_presets_well_formed():
         preset_cells("nope")
 
 
+@pytest.mark.slow
 def test_sweep_quick_end_to_end(tmp_path):
     """2 cells × 2 seeds through the full path: results.json with per-seed
     runs and mean±std aggregates, the markdown table, and the DP plot."""
