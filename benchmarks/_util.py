@@ -120,6 +120,23 @@ def timed_median(fn, params, steps, reps=5, label=""):
     return retry_timing(measure, floor=1e-3 / steps, label=label)
 
 
+def with_env(env: dict, fn, *a, **k):
+    """Run fn with env vars set, restoring previous values after —
+    single definition shared by bench.py's lever rows and the
+    profile-script A/B pins (the QFEDX_* knobs are read at trace time,
+    so each pinned build must trace inside the pinned window)."""
+    prev = {var: os.environ.get(var) for var in env}
+    os.environ.update(env)
+    try:
+        return fn(*a, **k)
+    finally:
+        for var, old in prev.items():
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
+
+
 def enable_cache(jax) -> None:
     """Point JAX's persistent compilation cache at the repo-local
     .jax_cache dir (single definition — bench.py, fused_sweep.py and

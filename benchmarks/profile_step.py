@@ -96,6 +96,100 @@ def group_ops(by_op):
     return buckets
 
 
+_TENSOR_RE = None
+
+
+def count_state_ops(txt: str, min_elems: int) -> dict:
+    """Count StableHLO ops by whether they TOUCH a state-sized tensor —
+    any operand or result type on the op line with ≥ ``min_elems``
+    elements, i.e. one traversal of a state-sized buffer (an HBM pass) —
+    vs trace-time-small ops (gate/coefficient/matrix-composition
+    arithmetic: 128×128 lane-matrix builds, 4×4 krons, iota masks —
+    bytes, not passes). Scanning every type on the line matters: a
+    scalar-result ``reduce`` still reads a state-sized operand, and a
+    ``broadcast_in_dim`` from a scalar still writes a state-sized
+    result; either is a pass. The fusion pass's claim is about the
+    state-sized count: raw op totals actually grow slightly under fusion
+    (the compositions add tiny ops) while state-sized ops — the
+    HBM-round-trip and scheduling-slot proxy PERF.md §11's floor model
+    prices — drop."""
+    global _TENSOR_RE
+    import re
+
+    if _TENSOR_RE is None:
+        _TENSOR_RE = re.compile(r"tensor<([0-9]+(?:x[0-9]+)*)x?[a-z]")
+    total, state = 0, 0
+    for ln in txt.splitlines():
+        if "= stablehlo." not in ln:
+            continue
+        total += 1
+        biggest = 0
+        for m in _TENSOR_RE.finditer(ln):
+            elems = 1
+            for d in m.group(1).split("x"):
+                elems *= int(d)
+            biggest = max(biggest, elems)
+        if biggest >= min_elems:
+            state += 1
+    return {"lowered_ops": total, "lowered_state_ops": state}
+
+
+def module_counts(fn, params, n_qubits, compiled=True):
+    """Op counts of the step program at two altitudes: the LOWERED
+    (StableHLO) module — split into state-sized vs small ops (see
+    count_state_ops; the state-sized count is what the fusion pass
+    shrinks), backend-independent given pinned routing — and the
+    COMPILED module: optimized-HLO instruction count plus the number of
+    ``fusion`` computations, a proxy for scheduled passes per step
+    (PERF.md §11's floor is ~one scheduling bubble per op).
+    ``compiled=False`` skips the backend compile — required off-chip,
+    where XLA:CPU compiles the unfused flip-form program pathologically
+    slowly (PERF.md §3b)."""
+    lowered = fn.lower(params)
+    out = count_state_ops(lowered.as_text(), 1 << n_qubits)
+    if not compiled:
+        return out
+    try:
+        ctxt = lowered.compile().as_text()
+        lines = [ln for ln in ctxt.splitlines() if " = " in ln]
+        out["compiled_instructions"] = len(lines)
+        out["compiled_fusions"] = sum(1 for ln in lines if " fusion(" in ln)
+    except Exception as e:  # noqa: BLE001 — counts must not kill profiling
+        out["compile_error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def run_hlo_counts(args):
+    """Before/after-fusion op counts for the ONE-step program (the
+    floor-reduction claim measured, not asserted — ISSUE r07 satellite).
+    Env pins are read at trace time, so each route builds fresh."""
+    import jax
+
+    from benchmarks._util import with_env
+
+    compiled = jax.default_backend() == "tpu"  # see module_counts
+    results = {}
+    for pin, label in (("1", "fused"), ("off", "unfused")):
+
+        def one():
+            fn, params, _ = build_step(
+                args.n, args.layers, args.batch, 1, remat=args.remat
+            )
+            return module_counts(fn, params, args.n, compiled=compiled)
+
+        results[label] = with_env({"QFEDX_FUSE": pin}, one)
+    for label, row in results.items():
+        print(f"[hlo:{label}] " + " ".join(f"{k}={v}" for k, v in row.items()))
+    f, u = results.get("fused", {}), results.get("unfused", {})
+    if "lowered_state_ops" in f and "lowered_state_ops" in u:
+        print(
+            f"[hlo] state-sized op reduction: {u['lowered_state_ops']} -> "
+            f"{f['lowered_state_ops']} "
+            f"({u['lowered_state_ops'] / max(f['lowered_state_ops'], 1):.2f}x)"
+        )
+    return results
+
+
 def run_one(tag, trace_dir, args):
     """Time + trace one configuration (QFEDX_* env set by the caller
     BEFORE the model is built — routing is read at build/trace time)."""
@@ -143,6 +237,12 @@ def main():
                     help="per-layer jax.checkpoint (the retired r04 n=20 "
                     "config — reproduces the cliff of docs/PERF.md §7; "
                     "the shipped bench runs n=20 without remat)")
+    ap.add_argument("--hlo-only", action="store_true",
+                    help="skip timing/tracing; report lowered + compiled "
+                    "op counts with the fusion pass on vs off (the r07 "
+                    "floor-reduction evidence — PERF.md §12). Runnable "
+                    "off-chip with the TPU routing pinned (QFEDX_GATE_"
+                    "FORM=flip QFEDX_SLAB_LANES=matmul QFEDX_BATCHED=1).")
     args = ap.parse_args()
 
     import jax
@@ -150,7 +250,14 @@ def main():
     enable_cache(jax)
     print(f"devices: {jax.devices()}")
 
+    if args.hlo_only:
+        run_hlo_counts(args)
+        return
+
     run_one("xla", args.trace_dir, args)
+    # Op-count evidence rides along with every profile: the same step
+    # program's emitted + compiled op counts, fusion pass on vs off.
+    run_hlo_counts(args)
 
 
 if __name__ == "__main__":

@@ -18,9 +18,61 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from qfedx_tpu.ops import gates
+from qfedx_tpu.ops import fuse, gates
 from qfedx_tpu.ops.statevector import apply_cnot, apply_gate, product_state
 from qfedx_tpu.circuits.encoders import angle_amplitudes
+
+
+# --- trace-level IR emission (ops/fuse.py) ---------------------------------
+#
+# Every ansatz layer is ALSO expressible as a flat gate trace: a list of
+# fuse.Op records with static qubit indices and traced coefficients. The
+# fusion pass rewrites that trace into per-layer super-gates (lane
+# matrices, row-pair 4×4s, phase masks) before it hits the engine —
+# fewer, fatter XLA ops per step, the r07 lever on the ~9–14 ms/step
+# non-streaming floor (docs/PERF.md §11–12). QFEDX_FUSE pins the route;
+# off-route the layer functions below run their original per-gate loops
+# unchanged. Noise stays correct by construction: traces never span a
+# Kraus channel boundary (channels are applied between layer traces).
+
+
+def _ring_ops(n_qubits: int) -> list:
+    """IR trace of the CNOT entangler ring (matches _entangle_ring)."""
+    if n_qubits < 2:
+        return []
+    ops = [fuse.Op("cnot", (q, q + 1)) for q in range(n_qubits - 1)]
+    if n_qubits > 2:
+        ops.append(fuse.Op("cnot", (n_qubits - 1, 0)))
+    return ops
+
+
+def hea_layer_ops(n_qubits: int, rx_angles, rz_angles) -> list:
+    """IR trace of one hardware-efficient layer (shared coefficients):
+    fused RZ·RX rotation per qubit, then the CNOT ring. Consumed by the
+    dense fused route below and by parallel/circuit.py (the sharded
+    engine runs the same trace through its own segment-and-fuse pass)."""
+    return [
+        fuse.Op("g1", (q,), gates.rot_zx(rx_angles[q], rz_angles[q]))
+        for q in range(n_qubits)
+    ] + _ring_ops(n_qubits)
+
+
+def _hea_layer_ops_b(n_qubits: int, rx_angles, rz_angles) -> list:
+    """Batched-slab layer trace, shared coefficients (the _b twins)."""
+    return hea_layer_ops(n_qubits, rx_angles, rz_angles)
+
+
+def _hea_layer_ops_cb(n_qubits: int, rx_angles, rz_angles) -> list:
+    """Client-folded layer trace: per-client (C,2,2) grouped rotation
+    stacks (gates.rot_zx_batched) — the fusion pass composes them into
+    grouped (C,128,128) lane matrices and (C,4,4) row-pair stacks, so
+    the folded federated path (docs/PERF.md §10) fuses too."""
+    return [
+        fuse.Op(
+            "g1", (q,), gates.rot_zx_batched(rx_angles[:, q], rz_angles[:, q])
+        )
+        for q in range(n_qubits)
+    ] + _ring_ops(n_qubits)
 
 
 def init_ansatz_params(
@@ -52,8 +104,15 @@ def ansatz_layer(state: jnp.ndarray, rx_angles, rz_angles) -> jnp.ndarray:
 
     The RX/RZ pair per qubit is applied as one fused 2×2 gate
     (gates.rot_zx) — half the state-sized contractions, same unitary.
+    At slab widths with QFEDX_FUSE on, the whole layer additionally runs
+    through the fusion pass (ops/fuse.py): lane rotations compose into
+    one 128×128 MXU matrix, row rotations merge pairwise into 4×4
+    super-gates, lane-lane ring CNOTs into one permutation matmul.
     """
     n = state.ndim
+    if fuse.fuse_active(n):
+        ops = hea_layer_ops(n, rx_angles, rz_angles)
+        return fuse.apply_fused(state, fuse.fuse_ops(ops, n))
     for q in range(n):
         state = apply_gate(state, gates.rot_zx(rx_angles[q], rz_angles[q]), q)
     return _entangle_ring(state, n)
@@ -96,12 +155,19 @@ def _entangle_ring_b(state, n_qubits: int):
     return state
 
 
-def ansatz_layer_b(state, n_qubits: int, rx_angles, rz_angles):
+def ansatz_layer_b(state, n_qubits: int, rx_angles, rz_angles, pre_ops=()):
     """Batched-slab twin of ``ansatz_layer``: same circuit, state shape
     (B, 2^n) with batch folded into slab rows (ops.batched — the layout
-    fix for scanned-batch training; docs/PERF.md §8)."""
+    fix for scanned-batch training; docs/PERF.md §8). ``pre_ops``: extra
+    IR ops prepended to the layer trace (the data-reuploading encoder
+    banks) so cross-boundary gates fuse into the same super-gates."""
     from qfedx_tpu.ops.batched import apply_gate_b
 
+    if fuse.fuse_active(n_qubits):
+        ops = list(pre_ops) + _hea_layer_ops_b(n_qubits, rx_angles, rz_angles)
+        return fuse.apply_fused_b(state, n_qubits, fuse.fuse_ops(ops, n_qubits))
+    for op in pre_ops:
+        state = apply_gate_b(state, n_qubits, op.coeffs, op.qubits[0])
     for q in range(n_qubits):
         state = apply_gate_b(
             state, n_qubits, gates.rot_zx(rx_angles[q], rz_angles[q]), q
@@ -121,13 +187,20 @@ def hardware_efficient_b(state, n_qubits: int, params: dict):
     return state
 
 
-def ansatz_layer_cb(state, n_qubits: int, rx_angles, rz_angles):
+def ansatz_layer_cb(state, n_qubits: int, rx_angles, rz_angles, pre_ops=()):
     """Client-folded ansatz layer: state (C·B, 2^n) with the CLIENT axis a
     leading group of the slab rows, angles (C, n) — one grouped gate
     (ops.batched per-group coefficients) per qubit instead of a client
-    vmap over C engine traces (docs/PERF.md §10)."""
+    vmap over C engine traces (docs/PERF.md §10). With QFEDX_FUSE on the
+    grouped stacks fuse like shared ones: (C,128,128) lane matrices and
+    (C,2,2,2,2) row-pair super-gates (ops/fuse.py)."""
     from qfedx_tpu.ops.batched import apply_gate_b
 
+    if fuse.fuse_active(n_qubits):
+        ops = list(pre_ops) + _hea_layer_ops_cb(n_qubits, rx_angles, rz_angles)
+        return fuse.apply_fused_b(state, n_qubits, fuse.fuse_ops(ops, n_qubits))
+    for op in pre_ops:
+        state = apply_gate_b(state, n_qubits, op.coeffs, op.qubits[0])
     for q in range(n_qubits):
         state = apply_gate_b(
             state,
@@ -155,7 +228,7 @@ def data_reuploading_cb(features, params: dict):
     params leaves (C, L, n). Re-encoding angles depend on (client, sample,
     qubit), so the encoder banks are per-sample gates over the C·B folded
     rows; the variational layers are per-client grouped gates."""
-    from qfedx_tpu.ops.batched import apply_gate_b, bstate_product
+    from qfedx_tpu.ops.batched import bstate_product
 
     c, b, n_qubits = features.shape
     n_layers = params["rx"].shape[1]
@@ -165,24 +238,34 @@ def data_reuploading_cb(features, params: dict):
             + params["enc_b"][:, layer][:, None]
         )  # (C, B, n)
         flat = angles.reshape(c * b, n_qubits)
+        pre_ops = ()
         if layer == 0:
             state = bstate_product(angle_amplitudes(flat, "ry"))
         else:
-            for q in range(n_qubits):
-                state = apply_gate_b(
-                    state, n_qubits, gates.ry_batched(flat[:, q]), q
-                )
+            # Re-encoding banks join the layer's gate trace as per-sample
+            # (C·B,2,2) IR ops: under QFEDX_FUSE their lane qubits fuse
+            # into one per-sample lane matrix and their row qubits pair
+            # up, instead of n separate engine passes (ops/fuse.py).
+            pre_ops = tuple(
+                fuse.Op("g1", (q,), gates.ry_batched(flat[:, q]))
+                for q in range(n_qubits)
+            )
         state = ansatz_layer_cb(
-            state, n_qubits, params["rx"][:, layer], params["rz"][:, layer]
+            state,
+            n_qubits,
+            params["rx"][:, layer],
+            params["rz"][:, layer],
+            pre_ops=pre_ops,
         )
     return state
 
 
 def data_reuploading_b(features, params: dict):
     """Batched-slab twin of ``data_reuploading``: features (B, n) in [0,1];
-    re-encoding banks are per-sample RY gates (gates.ry_batched)."""
+    re-encoding banks are per-sample RY gates (gates.ry_batched), joined
+    to the layer's gate trace so they fuse with it under QFEDX_FUSE."""
     from qfedx_tpu.circuits.encoders import angle_amplitudes
-    from qfedx_tpu.ops.batched import apply_gate_b, bstate_product
+    from qfedx_tpu.ops.batched import bstate_product
 
     n_layers, n_qubits = params["rx"].shape
     for layer in range(n_layers):
@@ -190,15 +273,20 @@ def data_reuploading_b(features, params: dict):
             params["enc_w"][layer][None] * (features * jnp.pi)
             + params["enc_b"][layer][None]
         )
+        pre_ops = ()
         if layer == 0:
             state = bstate_product(angle_amplitudes(angles, "ry"))
         else:
-            for q in range(n_qubits):
-                state = apply_gate_b(
-                    state, n_qubits, gates.ry_batched(angles[:, q]), q
-                )
+            pre_ops = tuple(
+                fuse.Op("g1", (q,), gates.ry_batched(angles[:, q]))
+                for q in range(n_qubits)
+            )
         state = ansatz_layer_b(
-            state, n_qubits, params["rx"][layer], params["rz"][layer]
+            state,
+            n_qubits,
+            params["rx"][layer],
+            params["rz"][layer],
+            pre_ops=pre_ops,
         )
     return state
 
@@ -229,6 +317,14 @@ def data_reuploading(
     n_layers, n_qubits = params["rx"].shape
 
     def block(state, angles, rx_l, rz_l):
+        if fuse.fuse_active(n_qubits):
+            # Re-encoding bank + variational layer as ONE trace: the RY
+            # bank's lane qubits fuse into the layer's lane matrix.
+            ops = [
+                fuse.Op("g1", (q,), gates.ry(angles[q]))
+                for q in range(n_qubits)
+            ] + hea_layer_ops(n_qubits, rx_l, rz_l)
+            return fuse.apply_fused(state, fuse.fuse_ops(ops, n_qubits))
         for q in range(n_qubits):
             state = apply_gate(state, gates.ry(angles[q]), q)
         return ansatz_layer(state, rx_l, rz_l)

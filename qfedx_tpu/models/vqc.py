@@ -123,6 +123,12 @@ def make_vqc_classifier(
     # batch-minor layouts from XLA inside scanned-batch training — 2–5×
     # slower at n ≥ 16 (docs/PERF.md §8). Engages at slab widths on TPU
     # (QFEDX_BATCHED pins); remat requests fall back to the vmap path.
+    # Orthogonally, the circuit-fusion pass (ops/fuse.py, QFEDX_FUSE,
+    # r07) rewrites each layer's gate trace into super-gates inside the
+    # ansatz functions themselves, so every route here — vmap, batched,
+    # client-folded — inherits it; under circuit-level noise the fusion
+    # barrier falls at each layer boundary where the Kraus channels act
+    # (noisy_forward_state), never across one.
     # The decision is made lazily at first apply (not at model build)
     # because the auto-route probes the backend platform — doing that at
     # build time would initialize the backend as a side effect, pinning

@@ -1,4 +1,4 @@
-from qfedx_tpu.ops import gates  # noqa: F401
+from qfedx_tpu.ops import fuse, gates  # noqa: F401
 from qfedx_tpu.ops.cpx import CArray, from_complex, to_complex  # noqa: F401
 from qfedx_tpu.ops.statevector import (  # noqa: F401
     apply_gate,

@@ -268,6 +268,144 @@ def apply_gate_b(state: CArray, n: int, gate: CArray, qubit: int) -> CArray:
     return _row_gate(state, b, n, gate, qubit, groups)
 
 
+def _coeff_groups(b: int, coeffs: CArray, gate_ndim: int) -> int | None:
+    """Group count of a coefficient stack with ``gate_ndim`` trailing gate
+    axes (None = shared), validated against the batch like apply_gate_b."""
+    lead = coeffs.re.ndim - gate_ndim
+    if lead == 0:
+        return None
+    if lead != 1:
+        raise ValueError(
+            f"coefficient stack has {lead} leading axes; expected ≤ 1"
+        )
+    groups = coeffs.re.shape[0]
+    if groups <= 0 or b % groups != 0:
+        raise ValueError(
+            f"grouped coefficients have {groups} groups but the batch is "
+            f"{b} rows — G must divide B"
+        )
+    return groups
+
+
+def apply_lane_matrix_b(state: CArray, n: int, mt: CArray) -> CArray:
+    """Composed (…,128,128) lane matrix on a batched (B, 2^n) slab in one
+    (grouped) MXU pass — the batched twin of statevector.apply_lane_matrix
+    (fusion pass, ops/fuse.py). ``mt``: (128,128) shared or (G,128,128)
+    grouped with G | B (per-client / per-sample coefficient stacks of the
+    folded federated path fuse into grouped lane matrices)."""
+    if n < _SLAB_MIN:
+        raise ValueError(f"batched engine needs n ≥ {_SLAB_MIN}, got {n}")
+    b = state.re.shape[0]
+    groups = _coeff_groups(b, mt, 2)
+    mt_re, mt_im = _cast_parts(mt, state.re.dtype)
+    return _lane_matmul(state, b, mt_re, mt_im, groups)
+
+
+def apply_rowpair_b(
+    state: CArray, n: int, gate: CArray, q1: int, q2: int
+) -> CArray:
+    """Merged 4×4 super-gate ``G[…,o1,o2,i1,i2]`` on two ROW qubits
+    q1 < q2 of the batched slab, one four-flip pass through the
+    (B·a,2,c,2,e,128) view — (G,…)-grouped stacks use the
+    (G,S·a,2,c,2,e,128) view with per-group coefficient grids, exactly
+    the ops.batched grouping contract (docs/PERF.md §10)."""
+    if n < _SLAB_MIN:
+        raise ValueError(f"batched engine needs n ≥ {_SLAB_MIN}, got {n}")
+    rbits = n - _LANE_BITS
+    if not 0 <= q1 < q2 < rbits:
+        raise ValueError(
+            f"rowpair needs row qubits q1 < q2 < {rbits}, got ({q1}, {q2})"
+        )
+    b = state.re.shape[0]
+    groups = _coeff_groups(b, gate, 4)
+    dtype = state.re.dtype
+    gre, gim = _cast_parts(gate, dtype)
+    shape = state.re.shape
+    a = 1 << q1
+    c = 1 << (q2 - q1 - 1)
+    e = 1 << (rbits - q2 - 1)
+    if groups is None:
+        view = (b * a, 2, c, 2, e, _LANES)
+        ax1, ax2 = 1, 3
+        gshape = (1, 2, 1, 2, 1, 1)
+    else:
+        view = (groups, (b // groups) * a, 2, c, 2, e, _LANES)
+        ax1, ax2 = 2, 4
+        gshape = (groups, 1, 2, 1, 2, 1, 1)
+
+    # The four flip-combination grids C_{dj,dk}[i,l] = G[…,i,l,i^dj,l^dk]
+    # (statevector._coeffs_2q generalized over leading group axes).
+    i, l = jnp.meshgrid(jnp.arange(2), jnp.arange(2), indexing="ij")
+
+    def grids(part):
+        return [
+            part[..., i, l, i ^ dj, l ^ dk].reshape(gshape)
+            for dj, dk in ((0, 0), (0, 1), (1, 0), (1, 1))
+        ]
+
+    def flips(s):
+        v = s.reshape(view)
+        f2 = jnp.flip(v, ax2)
+        f1 = jnp.flip(v, ax1)
+        return v, f2, f1, jnp.flip(f1, ax2)
+
+    def lin(cs, fs):
+        return (
+            cs[0] * fs[0] + cs[1] * fs[1] + cs[2] * fs[2] + cs[3] * fs[3]
+        ).reshape(shape)
+
+    re_c = grids(gre)
+    fs_re = flips(state.re)
+    if gim is None and state.im is None:
+        return CArray(lin(re_c, fs_re), None)
+    if gim is None:
+        fs_im = flips(state.im)
+        return CArray(lin(re_c, fs_re), lin(re_c, fs_im))
+    im_c = grids(gim)
+    if state.im is None:
+        return CArray(lin(re_c, fs_re), lin(im_c, fs_re))
+    fs_im = flips(state.im)
+    return CArray(
+        lin(re_c, fs_re) - lin(im_c, fs_im),
+        lin(re_c, fs_im) + lin(im_c, fs_re),
+    )
+
+
+def apply_phase_mask_b(state: CArray, n: int, mask: CArray) -> CArray:
+    """Precomputed (…,2^n) phase mask on the batched slab in one multiply
+    (fusion pass diagonal chaining). Shared (2^n,) masks broadcast over
+    the batch; grouped (G,2^n) masks apply per contiguous row group."""
+    if n < _SLAB_MIN:
+        raise ValueError(f"batched engine needs n ≥ {_SLAB_MIN}, got {n}")
+    b = state.re.shape[0]
+    groups = _coeff_groups(b, mask, 1)
+    shape = state.re.shape
+    m_re, m_im = _cast_parts(mask, state.re.dtype)
+    if groups is None:
+        view = shape
+        m_re = m_re[None, :]
+        m_im = None if m_im is None else m_im[None, :]
+    else:
+        view = (groups, b // groups, 1 << n)
+        m_re = m_re[:, None, :]
+        m_im = None if m_im is None else m_im[:, None, :]
+
+    def mul(s, m):
+        return (s.reshape(view) * m).reshape(shape)
+
+    if m_im is None:
+        return CArray(
+            mul(state.re, m_re),
+            None if state.im is None else mul(state.im, m_re),
+        )
+    if state.im is None:
+        return CArray(mul(state.re, m_re), mul(state.re, m_im))
+    return CArray(
+        mul(state.re, m_re) - mul(state.im, m_im),
+        mul(state.re, m_im) + mul(state.im, m_re),
+    )
+
+
 def apply_cnot_b(state: CArray, n: int, ctrl: int, tgt: int) -> CArray:
     """CNOT on a batched (B, 2^n) state: four row/lane cases, batch-folded."""
     if n < _SLAB_MIN:

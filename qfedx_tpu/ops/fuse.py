@@ -1,0 +1,503 @@
+"""Circuit-fusion compiler: collapse a gate trace into per-layer super-gates.
+
+Why (docs/PERF.md §11, measured): the bf16 gap to the per-gate streaming
+bound is a ~9–14 ms/step *dtype-invariant floor* of non-streaming time —
+scheduling bubbles plus one XLA op (and roughly one HBM round trip) per
+gate. The lever is fewer, fatter ops per step, and the slab layout
+(ops/statevector.py) already has the structure to exploit:
+
+- **Lane fusion.** Every gate on the 7 lane qubits is (or can be written
+  as) a 128×128 matrix applied by ``(R,128) × (128,128)`` matmul —
+  rotations (``_lane_mt``), lane-lane CNOTs (permutation matrices), and
+  diagonal gates (diagonal matrices). Matmuls compose: ``(s@M1)@M2 =
+  s@(M1@M2)``, and the composition is a handful of *tiny* 128×128 matmuls
+  at trace cost ≪ one state pass. A layer's ≤ ~10 lane ops become one
+  (two, with the HEA ring's row↔lane boundary CNOTs) MXU passes.
+- **Row-pair fusion.** Two single-qubit gates on *distinct* row qubits
+  commute and merge into one 4×4 super-gate ``G[o1,o2,i1,i2] =
+  A[o1,i1]·B[o2,i2]`` applied through an ``(a,2,c,2,e,128)`` view in a
+  single four-flip elementwise pass — one HBM round trip where the
+  unfused gates took two. Consecutive gates on the *same* qubit compose
+  at the 2×2 level (free).
+- **Diagonal chaining.** A run of diagonal gates (RZ, CZ/CPhase) is one
+  diagonal: the pass precomputes the combined phase mask (a ``(2^n,)``
+  product of per-factor broadcasts that XLA folds into the multiply) and
+  applies it in ONE elementwise pass regardless of run length.
+
+The IR is a flat list of ``Op`` records emitted by ``circuits/ansatz.py``
+(and ``parallel/circuit.py`` for the sharded twin): ``kind`` ∈ {"g1",
+"cnot", "g2", "diag1", "diag2"}, static Python qubit indices, traced
+CArray coefficients. Grouped coefficient stacks — the batched engine's
+per-sample ``(B,2,2)`` and the folded federated path's per-client
+``(G,2,2)`` forms (docs/PERF.md §10) — ride the same pass: compositions
+broadcast over the leading group axes, so the client-folded r06 path
+fuses too. ``fuse_ops`` is a single greedy pass that reorders only
+provably-commuting ops (disjoint qubit sets; an accumulator is flushed
+the moment an overlapping op arrives), so the fused program equals the
+unfused one up to float re-association.
+
+Noise caveat (tested): Kraus channel insertion points are *barriers* —
+traces are built per layer/block, channels are applied between them via
+``noise.trajectory`` / ``parallel.sharded`` directly, so no fusion ever
+spans a channel boundary and trajectory PRNG streams are unchanged.
+
+``QFEDX_FUSE`` pins the route ("1"/"on", "0"/"off"); default follows the
+backend like the other engine knobs (on for TPU — the fusions are slab
+forms; off on CPU, whose production path is the tensordot engine). Read
+at TRACE time and not part of any jit cache key: set it before the first
+trace (see statevector._gate_form for the wrong-path-measured warning).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from qfedx_tpu.ops import statevector as sv
+from qfedx_tpu.ops.cpx import CArray, RDTYPE, cmul
+from qfedx_tpu.ops.statevector import _LANE_BITS, _LANES, _SLAB_MIN
+
+
+class Op(NamedTuple):
+    """One gate of the trace-level IR.
+
+    kind ∈ {"g1", "cnot", "g2", "diag1", "diag2"}; ``qubits`` are static
+    Python ints (trace-time circuit structure); ``coeffs`` is a traced
+    CArray — (…,2,2) for g1, (…,2,2,2,2) ``G[o1,o2,i1,i2]`` for g2,
+    (…,2) diagonal entries for diag1, (…,2,2) entries ``d[b1,b2]`` for
+    diag2, None for cnot. Leading axes are coefficient groups (shared =
+    none; per-client (G,…); per-sample (B,…) — ops.batched's forms).
+    """
+
+    kind: str
+    qubits: tuple
+    coeffs: CArray | None = None
+
+
+class FusedOp(NamedTuple):
+    """One op of the fused program: the IR kinds pass through unfused,
+    plus "lane" (composed (…,128,128) lane matrix), "rowpair" (merged
+    (…,2,2,2,2) super-gate on two row qubits, qubits sorted) and "mask"
+    (precomputed (…,2^n) phase mask)."""
+
+    kind: str
+    qubits: tuple
+    coeffs: object = None
+
+
+def fuse_enabled() -> bool:
+    """Route circuits through the fusion pass?  QFEDX_FUSE pins
+    ("1"/"on" or "0"/"off"); default = TPU backend — the fused forms are
+    slab/matmul programs (the TPU production path; on CPU the default
+    engine is the tensordot form the fusions don't apply to). Read at
+    trace time; like QFEDX_DTYPE, set it BEFORE the first trace."""
+    env = os.environ.get("QFEDX_FUSE")
+    if env is not None:
+        if env not in ("0", "1", "on", "off"):
+            # A typo would silently measure the other route — the
+            # wrong-path-measured error class (ADVICE r04 item 1).
+            raise ValueError(
+                f"QFEDX_FUSE={env!r}: expected '1'/'on' or '0'/'off'"
+            )
+        return env in ("1", "on")
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001 — no backend yet: conservative
+        return False
+
+
+def fuse_active(n_qubits: int, min_width: int = _SLAB_MIN) -> bool:
+    """Fusion engages only at widths where the slab forms it emits are
+    the production layout (callers pass min_width=_LANE_BITS for the
+    sharded local shard, whose slab floor is one full lane register)."""
+    return n_qubits >= min_width and fuse_enabled()
+
+
+# --- complex composition helpers (all trace-time-tiny) ----------------------
+
+
+def _cmatmul(a: CArray, b: CArray) -> CArray:
+    """a @ b over the last two axes, broadcasting leading group axes,
+    with the real-part shortcuts of cpx."""
+    rr = a.re @ b.re
+    if a.im is None and b.im is None:
+        return CArray(rr, None)
+    if a.im is None:
+        return CArray(rr, a.re @ b.im)
+    if b.im is None:
+        return CArray(rr, a.im @ b.re)
+    return CArray(rr - a.im @ b.im, a.re @ b.im + a.im @ b.re)
+
+
+def _ckron2(a: CArray, b: CArray) -> CArray:
+    """super[…,o1,o2,i1,i2] = a[…,o1,i1]·b[…,o2,i2] — the 4×4 merge of
+    two commuting single-qubit gates (a on the lower qubit index)."""
+
+    def k(x, y):
+        return x[..., :, None, :, None] * y[..., None, :, None, :]
+
+    rr = k(a.re, b.re)
+    if a.im is None and b.im is None:
+        return CArray(rr, None)
+    a_im = a.im if a.im is not None else jnp.zeros_like(a.re)
+    b_im = b.im if b.im is not None else jnp.zeros_like(b.re)
+    return CArray(
+        rr - k(a_im, b_im), k(a.re, b_im) + k(a_im, b.re)
+    )
+
+
+def _lead(c: CArray, trailing: int) -> tuple:
+    """Leading (group) axes of a coefficient array with ``trailing``
+    gate axes."""
+    return c.re.shape[: c.re.ndim - trailing]
+
+
+def _lead_compatible(s1: tuple, s2: tuple) -> bool:
+    """Two coefficient stacks compose only if their group axes broadcast
+    (shared () composes with anything; (C,…) with (C,…)). Mixing e.g.
+    per-sample (C·B,…) encoder banks with per-client (C,…) variational
+    stacks must flush instead (reupload_cb emits exactly that sequence)."""
+    return s1 == s2 or s1 == () or s2 == ()
+
+
+# --- lane-matrix builders ---------------------------------------------------
+
+
+def _lane_map(coeffs: CArray, build) -> CArray:
+    return CArray(
+        build(coeffs.re),
+        None if coeffs.im is None else build(coeffs.im),
+    )
+
+
+def _lane_g1(coeffs: CArray, p: int) -> CArray:
+    """(…,2,2) gate on lane bit p → (…,128,128) Mt (statevector._lane_mt
+    broadcasts leading group axes)."""
+    return _lane_map(coeffs, lambda part: sv._lane_mt(part, p))
+
+
+def _lane_diag1(coeffs: CArray, p: int) -> CArray:
+    """(…,2) diagonal on lane bit p → diagonal (…,128,128) matrix."""
+    j, l = sv._lane_iota()
+    eye = j == l
+    bit = (l >> p) & 1
+
+    def build(vals):
+        v = jnp.where(
+            bit == 1, vals[..., 1][..., None, None], vals[..., 0][..., None, None]
+        )
+        return jnp.where(eye, v, jnp.zeros((), dtype=vals.dtype))
+
+    return _lane_map(coeffs, build)
+
+
+def _lane_diag2(coeffs: CArray, p1: int, p2: int) -> CArray:
+    """(…,2,2) two-qubit diagonal d[b1,b2] on lane bits (p1,p2) →
+    diagonal (…,128,128) matrix."""
+    j, l = sv._lane_iota()
+    eye = j == l
+    b1 = (l >> p1) & 1
+    b2 = (l >> p2) & 1
+
+    def build(vals):
+        def e(r, c):
+            return vals[..., r, c][..., None, None]
+
+        v = jnp.where(
+            b1 == 0,
+            jnp.where(b2 == 0, e(0, 0), e(0, 1)),
+            jnp.where(b2 == 0, e(1, 0), e(1, 1)),
+        )
+        return jnp.where(eye, v, jnp.zeros((), dtype=vals.dtype))
+
+    return _lane_map(coeffs, build)
+
+
+# --- diagonal-run mask builder ----------------------------------------------
+
+
+def _mask_factor(op: Op, n: int) -> CArray:
+    """One diagonal factor broadcast over the flat (…,2^n) index space."""
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1 << n,), 0)
+    if op.kind == "diag1":
+        bit = (idx >> (n - 1 - op.qubits[0])) & 1
+
+        def pick(vals):
+            return jnp.where(
+                bit == 1, vals[..., 1][..., None], vals[..., 0][..., None]
+            )
+
+        return _lane_map(op.coeffs, pick)
+    # diag2: d[b1, b2]
+    b1 = (idx >> (n - 1 - op.qubits[0])) & 1
+    b2 = (idx >> (n - 1 - op.qubits[1])) & 1
+
+    def pick2(vals):
+        def e(r, c):
+            return vals[..., r, c][..., None]
+
+        return jnp.where(
+            b1 == 0,
+            jnp.where(b2 == 0, e(0, 0), e(0, 1)),
+            jnp.where(b2 == 0, e(1, 0), e(1, 1)),
+        )
+
+    return _lane_map(op.coeffs, pick2)
+
+
+def _build_mask(facs: list, n: int) -> CArray:
+    mask = _mask_factor(facs[0], n)
+    for op in facs[1:]:
+        mask = cmul(mask, _mask_factor(op, n))
+    return mask
+
+
+# --- diag → dense-gate conversions (unfused fallback / reference path) ------
+
+
+def diag1_gate(coeffs: CArray) -> CArray:
+    """(…,2) diagonal entries → (…,2,2) gate matrix (off-diagonal zero)."""
+
+    def build(vals):
+        z = jnp.zeros_like(vals[..., 0])
+        return jnp.stack(
+            [
+                jnp.stack([vals[..., 0], z], axis=-1),
+                jnp.stack([z, vals[..., 1]], axis=-1),
+            ],
+            axis=-2,
+        )
+
+    return _lane_map(coeffs, build)
+
+
+def diag2_gate(coeffs: CArray) -> CArray:
+    """(…,2,2) entries d[b1,b2] → (…,2,2,2,2) gate tensor
+    G[o1,o2,i1,i2] = d[i1,i2]·δ(o1,i1)·δ(o2,i2)."""
+    eye = jnp.eye(2, dtype=RDTYPE)
+
+    def build(vals):
+        return (
+            vals[..., None, None, :, :]
+            * eye[:, None, :, None]
+            * eye[None, :, None, :]
+        )
+
+    return _lane_map(coeffs, build)
+
+
+# --- the fusion pass --------------------------------------------------------
+
+
+def fuse_ops(ops: list, n: int) -> list:
+    """Greedy one-pass fusion of an IR trace for an n-qubit state.
+
+    Maintains three accumulators — a composed lane matrix, one pending
+    row single, a diagonal run — and flushes an accumulator exactly when
+    an op overlapping its qubits arrives, so every reorder is between
+    ops on disjoint qubits (which commute). Width-aware: lane fusion
+    needs n ≥ 7 (one full lane register), row-pair fusion needs both
+    qubits in the row region q < n−7; anything unfusible at this width
+    passes through unchanged, so the pass is safe at every n.
+    """
+    lane_region = n - _LANE_BITS
+    has_lanes = n >= _LANE_BITS
+
+    def is_lane(q: int) -> bool:
+        return has_lanes and q >= lane_region
+
+    out: list = []
+    lane_acc: CArray | None = None
+    lane_qs: set = set()
+    row_q: int | None = None
+    row_gate: CArray | None = None
+    diag_facs: list = []
+    diag_qs: set = set()
+
+    def flush_lane():
+        nonlocal lane_acc, lane_qs
+        if lane_acc is not None:
+            out.append(FusedOp("lane", tuple(sorted(lane_qs)), lane_acc))
+            lane_acc, lane_qs = None, set()
+
+    def flush_row():
+        nonlocal row_q, row_gate
+        if row_q is not None:
+            out.append(FusedOp("g1", (row_q,), row_gate))
+            row_q, row_gate = None, None
+
+    def flush_diag():
+        nonlocal diag_facs, diag_qs
+        if diag_facs:
+            out.append(
+                FusedOp(
+                    "mask", tuple(sorted(diag_qs)), _build_mask(diag_facs, n)
+                )
+            )
+            diag_facs, diag_qs = [], set()
+
+    def fold_lane(mt: CArray, qs: set):
+        nonlocal lane_acc, lane_qs
+        if lane_acc is not None and not _lead_compatible(
+            _lead(lane_acc, 2), _lead(mt, 2)
+        ):
+            flush_lane()
+        lane_acc = mt if lane_acc is None else _cmatmul(lane_acc, mt)
+        lane_qs |= qs
+
+    for op in ops:
+        qs = set(op.qubits)
+        if op.kind == "g1":
+            q = op.qubits[0]
+            if qs & diag_qs:
+                flush_diag()
+            if is_lane(q):
+                fold_lane(_lane_g1(op.coeffs, sv._slab_pos(n, q)), qs)
+            elif row_q is None:
+                row_q, row_gate = q, op.coeffs
+            elif row_q == q:
+                if _lead_compatible(_lead(row_gate, 2), _lead(op.coeffs, 2)):
+                    # Sequential A then B on one qubit is the matrix B·A.
+                    row_gate = _cmatmul(op.coeffs, row_gate)
+                else:
+                    flush_row()
+                    row_q, row_gate = q, op.coeffs
+            elif _lead_compatible(_lead(row_gate, 2), _lead(op.coeffs, 2)):
+                q1, g1_, q2, g2_ = (
+                    (row_q, row_gate, q, op.coeffs)
+                    if row_q < q
+                    else (q, op.coeffs, row_q, row_gate)
+                )
+                out.append(FusedOp("rowpair", (q1, q2), _ckron2(g1_, g2_)))
+                row_q, row_gate = None, None
+            else:
+                flush_row()
+                row_q, row_gate = q, op.coeffs
+        elif op.kind == "cnot":
+            if qs & diag_qs:
+                flush_diag()
+            if row_q in qs:
+                flush_row()
+            if is_lane(op.qubits[0]) and is_lane(op.qubits[1]):
+                mt = CArray(
+                    sv._lane_perm_cnot(
+                        sv._slab_pos(n, op.qubits[0]),
+                        sv._slab_pos(n, op.qubits[1]),
+                        RDTYPE,
+                    ),
+                    None,
+                )
+                fold_lane(mt, qs)
+            else:
+                if qs & lane_qs:
+                    flush_lane()
+                out.append(FusedOp("cnot", op.qubits, None))
+        elif op.kind in ("diag1", "diag2"):
+            if row_q in qs:
+                flush_row()
+            if all(is_lane(q) for q in qs) and lane_acc is not None:
+                # A lane matmul is already pending: composing the diagonal
+                # in is free; starting one just for a diagonal is not.
+                p = [sv._slab_pos(n, q) for q in op.qubits]
+                mt = (
+                    _lane_diag1(op.coeffs, p[0])
+                    if op.kind == "diag1"
+                    else _lane_diag2(op.coeffs, p[0], p[1])
+                )
+                fold_lane(mt, qs)
+            else:
+                if qs & lane_qs:
+                    flush_lane()
+                diag_facs.append(op)
+                diag_qs |= qs
+        elif op.kind == "g2":
+            # General two-qubit gates don't fuse (CNOT — the only 2q gate
+            # in the hot paths — and diagonals have their own routes).
+            if qs & diag_qs:
+                flush_diag()
+            if row_q in qs:
+                flush_row()
+            if qs & lane_qs:
+                flush_lane()
+            out.append(FusedOp("g2", op.qubits, op.coeffs))
+        else:
+            raise ValueError(f"unknown IR op kind {op.kind!r}")
+    flush_diag()
+    flush_row()
+    flush_lane()
+    return out
+
+
+# --- executors --------------------------------------------------------------
+
+
+def apply_fused(state: CArray, fused: list) -> CArray:
+    """Run a fused program on a dense (2,)*n state (shared coefficients
+    only — the single-state engine has no group axis). Unfused kinds
+    route through the ordinary engine entry points, which pick the
+    per-backend formulation as usual."""
+    for op in fused:
+        if op.kind == "g1":
+            state = sv.apply_gate(state, op.coeffs, op.qubits[0])
+        elif op.kind == "cnot":
+            state = sv.apply_cnot(state, *op.qubits)
+        elif op.kind == "g2":
+            state = sv.apply_gate_2q(state, op.coeffs, *op.qubits)
+        elif op.kind == "lane":
+            state = sv.apply_lane_matrix(state, op.coeffs)
+        elif op.kind == "rowpair":
+            state = sv.apply_rowpair(state, op.coeffs, *op.qubits)
+        elif op.kind == "mask":
+            state = sv.apply_phase_mask(state, op.coeffs)
+        else:  # pragma: no cover — fuse_ops emits only the kinds above
+            raise ValueError(f"unknown fused op kind {op.kind!r}")
+    return state
+
+
+def apply_fused_b(state: CArray, n: int, fused: list) -> CArray:
+    """Run a fused program on a batched (B, 2^n) slab; grouped (G,…)
+    coefficient stacks (per-client / per-sample) apply per contiguous
+    row group exactly as ops.batched.apply_gate_b."""
+    from qfedx_tpu.ops import batched as bt
+
+    for op in fused:
+        if op.kind == "g1":
+            state = bt.apply_gate_b(state, n, op.coeffs, op.qubits[0])
+        elif op.kind == "cnot":
+            state = bt.apply_cnot_b(state, n, *op.qubits)
+        elif op.kind == "lane":
+            state = bt.apply_lane_matrix_b(state, n, op.coeffs)
+        elif op.kind == "rowpair":
+            state = bt.apply_rowpair_b(state, n, op.coeffs, *op.qubits)
+        elif op.kind == "mask":
+            state = bt.apply_phase_mask_b(state, n, op.coeffs)
+        else:
+            raise ValueError(
+                f"fused op kind {op.kind!r} has no batched executor"
+            )
+    return state
+
+
+def apply_ops_unfused(state: CArray, ops: list) -> CArray:
+    """Gate-by-gate reference executor for an IR trace on a dense state
+    (the A/B baseline the parity tests pin the fused program against;
+    diagonals apply as ordinary gates with zero off-diagonals)."""
+    for op in ops:
+        if op.kind == "g1":
+            state = sv.apply_gate(state, op.coeffs, op.qubits[0])
+        elif op.kind == "cnot":
+            state = sv.apply_cnot(state, *op.qubits)
+        elif op.kind == "g2":
+            state = sv.apply_gate_2q(state, op.coeffs, *op.qubits)
+        elif op.kind == "diag1":
+            state = sv.apply_gate(state, diag1_gate(op.coeffs), op.qubits[0])
+        elif op.kind == "diag2":
+            state = sv.apply_gate_2q(
+                state, diag2_gate(op.coeffs), *op.qubits
+            )
+        else:
+            raise ValueError(f"unknown IR op kind {op.kind!r}")
+    return state

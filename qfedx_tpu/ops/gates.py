@@ -148,6 +148,48 @@ def rot_zx(theta, phi) -> CArray:
     return CArray(re, im)
 
 
+# --- diagonal-gate coefficient forms (trace IR "diag1"/"diag2" kinds) ------
+#
+# RZ / CZ / CPhase are diagonal in the computational basis, so the fusion
+# pass (ops/fuse.py) chains runs of them into ONE precomputed phase mask
+# applied in a single multiply. These constructors return the compact
+# diagonal entries — (…,2) per-qubit or (…,2,2) per-pair — rather than
+# full gate matrices; ``fuse.diag1_gate``/``diag2_gate`` expand them when
+# an unfused engine path needs the dense form.
+
+
+def rz_diag(theta) -> CArray:
+    """RZ(θ) as diagonal entries [e^{-iθ/2}, e^{iθ/2}], shape (…,2) —
+    broadcasting over leading batch/group axes of ``theta``."""
+    theta = jnp.asarray(theta, dtype=RDTYPE)
+    c, s = jnp.cos(theta / 2), jnp.sin(theta / 2)
+    return CArray(
+        jnp.stack([c, c], axis=-1), jnp.stack([-s, s], axis=-1)
+    )
+
+
+CZ_DIAG = CArray(jnp.array([[1.0, 1.0], [1.0, -1.0]], dtype=RDTYPE), None)
+"""CZ as (2,2) diagonal entries d[b_ctrl, b_tgt] (real)."""
+
+
+def cphase_diag(theta) -> CArray:
+    """Controlled-phase diag(1,1,1,e^{iθ}) as (…,2,2) entries d[b1,b2]."""
+    theta = jnp.asarray(theta, dtype=RDTYPE)
+    one = jnp.ones_like(theta)
+    zero = jnp.zeros_like(theta)
+    re = jnp.stack(
+        [jnp.stack([one, one], axis=-1),
+         jnp.stack([one, jnp.cos(theta)], axis=-1)],
+        axis=-2,
+    )
+    im = jnp.stack(
+        [jnp.stack([zero, zero], axis=-1),
+         jnp.stack([zero, jnp.sin(theta)], axis=-1)],
+        axis=-2,
+    )
+    return CArray(re, im)
+
+
 def crz(theta) -> CArray:
     """Controlled-RZ as a (2,2,2,2) tensor (control = first index pair)."""
     theta = jnp.asarray(theta, dtype=RDTYPE)

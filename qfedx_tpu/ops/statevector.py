@@ -505,6 +505,67 @@ def apply_gate_2q(state: CArray, gate: CArray, q1: int, q2: int) -> CArray:
     return _apply_ax_2q(gate, state, q1, q2)
 
 
+def apply_lane_matrix(state: CArray, mt: CArray) -> CArray:
+    """Apply a pre-composed (128,128) unitary to the 7 lane qubits in ONE
+    (R,128)×(128,128) MXU pass — the execution primitive of the fusion
+    pass's lane fusion (ops/fuse.py): a whole layer's lane gates
+    (rotations, lane-lane CNOT permutations, diagonals) compose into
+    ``mt`` at trace time, so the state makes one HBM round trip where the
+    per-gate path made up to ~10. Requires n ≥ _LANE_BITS."""
+    n = state.ndim
+    if n < _LANE_BITS:
+        raise ValueError(f"lane matrix needs n ≥ {_LANE_BITS}, got {n}")
+    shape = state.shape
+    mt = _cast_gate(mt, state)
+    flat = _creshape(state, (1 << (n - _LANE_BITS), _LANES))
+    return _creshape(_matmul_lane(flat, mt.re, mt.im), shape)
+
+
+def apply_rowpair(state: CArray, gate: CArray, q1: int, q2: int) -> CArray:
+    """Apply a merged 4×4 super-gate ``G[o1,o2,i1,i2]`` to two ROW qubits
+    q1 < q2 through the slab pair view (a,2,c,2,e,128) in one four-flip
+    elementwise pass (fusion pass row-pair fusion, ops/fuse.py). Unlike
+    the general ``apply_gate_2q`` this never leaves the slab layout: both
+    flips are on leading axes of a minor-dim-128 view, so the pass is one
+    HBM round trip — half what the two unfused gates cost."""
+    n = state.ndim
+    rbits = n - _LANE_BITS
+    if not 0 <= q1 < q2 < rbits:
+        raise ValueError(
+            f"rowpair needs row qubits q1 < q2 < {rbits}, got ({q1}, {q2})"
+        )
+    shape = state.shape
+    a = 1 << q1
+    c = 1 << (q2 - q1 - 1)
+    e = 1 << (rbits - q2 - 1)
+    view = _creshape(state, (a, 2, c, 2, e, _LANES))
+    return _creshape(_apply_ax_2q(gate, view, 1, 3), shape)
+
+
+def apply_phase_mask(state: CArray, mask: CArray) -> CArray:
+    """Multiply the state by a precomputed (2^n,) diagonal (phase) mask —
+    a chained run of diagonal gates (RZ, CZ/CPhase) collapsed into ONE
+    elementwise pass (fusion pass diagonal chaining, ops/fuse.py). The
+    mask product itself is built from per-factor bit-select broadcasts
+    that XLA folds into this multiply."""
+    shape = state.shape
+    mask = _cast_gate(mask, state)
+    flat = _creshape(state, (-1,))
+    if mask.im is None:
+        out = CArray(
+            flat.re * mask.re,
+            None if flat.im is None else flat.im * mask.re,
+        )
+    elif flat.im is None:
+        out = CArray(flat.re * mask.re, flat.re * mask.im)
+    else:
+        out = CArray(
+            flat.re * mask.re - flat.im * mask.im,
+            flat.re * mask.im + flat.im * mask.re,
+        )
+    return _creshape(out, shape)
+
+
 def apply_cnot(state: CArray, ctrl: int, tgt: int) -> CArray:
     """CNOT as a masked select: out = where(bit_ctrl, flip_tgt(ψ), ψ).
 

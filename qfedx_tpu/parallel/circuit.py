@@ -15,18 +15,65 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from qfedx_tpu.circuits.ansatz import hea_layer_ops
 from qfedx_tpu.circuits.encoders import angle_amplitudes
-from qfedx_tpu.ops import gates
+from qfedx_tpu.ops import fuse
+from qfedx_tpu.ops.statevector import _LANE_BITS
 from qfedx_tpu.parallel.sharded import (
     ShardCtx,
     amplitude_encode_local,
     apply_channel_all_sharded,
-    apply_cnot_sharded,
-    apply_gate_sharded,
+    apply_op_sharded,
     expect_z_all_sharded,
     product_state_local,
 )
 from qfedx_tpu.utils.compat import shard_map
+
+
+def _apply_ops_sharded(ctx: ShardCtx, state, ops: list):
+    """Execute a trace-IR segment on the sharded state.
+
+    With the fusion pass active (QFEDX_FUSE; needs ≥ one full lane
+    register of local qubits), maximal runs of fully-LOCAL ops are
+    remapped to local axes, fused (ops/fuse.py) and applied to the local
+    shard as slab super-gates — lane fusion is sharding-oblivious because
+    the 7 lane qubits are the last 7 and therefore always local; row-pair
+    fusion touches only local row qubits by the same remap. Ops touching
+    a GLOBAL qubit are barriers: applied per-gate through the ppermute
+    primitives in original order (no reordering across the segment
+    boundary, so correctness is positional, not commutation-dependent).
+    Off-route this is exactly the old per-gate loop."""
+    fused_route = fuse.fuse_active(ctx.n_local, min_width=_LANE_BITS)
+    if not fused_route:
+        for op in ops:
+            state = apply_op_sharded(ctx, state, op)
+        return state
+
+    run: list = []
+
+    def flush(state):
+        if run:
+            local = [
+                fuse.Op(
+                    o.kind,
+                    tuple(ctx.local_axis(q) for q in o.qubits),
+                    o.coeffs,
+                )
+                for o in run
+            ]
+            state = fuse.apply_fused(
+                state, fuse.fuse_ops(local, ctx.n_local)
+            )
+            run.clear()
+        return state
+
+    for op in ops:
+        if min(op.qubits) >= ctx.n_global:
+            run.append(op)
+        else:
+            state = flush(state)
+            state = apply_op_sharded(ctx, state, op)
+    return flush(state)
 
 
 def sharded_encoded_state(ctx: ShardCtx, features: jnp.ndarray, encoding: str):
@@ -58,18 +105,17 @@ def sharded_hea_state(
     state = sharded_encoded_state(ctx, features, encoding)
     n_layers = params["rx"].shape[0]
     for layer in range(n_layers):
-        for q in range(n):
-            state = apply_gate_sharded(
-                ctx,
-                state,
-                gates.rot_zx(params["rx"][layer, q], params["rz"][layer, q]),
-                q,
-            )
-        if n >= 2:
-            for q in range(n - 1):
-                state = apply_cnot_sharded(ctx, state, q, q + 1)
-            if n > 2:
-                state = apply_cnot_sharded(ctx, state, n - 1, 0)
+        # One layer = one IR trace (circuits.ansatz.hea_layer_ops — the
+        # exact gate sequence the dense engines run), executed through
+        # the segment-and-fuse pass above. Kraus channels stay OUTSIDE
+        # the trace: a channel is a hard barrier the fusion pass must
+        # never cross (ops/fuse.py), and keying is unchanged so sharded
+        # and dense trajectories still coincide sample-for-sample.
+        state = _apply_ops_sharded(
+            ctx,
+            state,
+            hea_layer_ops(n, params["rx"][layer], params["rz"][layer]),
+        )
         for ci, kraus in enumerate(channels):
             state = apply_channel_all_sharded(
                 ctx, state, kraus, jax.random.fold_in(key, layer * 8 + ci)

@@ -336,6 +336,34 @@ def _sharded_2q(ctx: ShardCtx, state: CArray, q1: int, q2: int, local_apply):
     return state
 
 
+def apply_op_sharded(ctx: ShardCtx, state: CArray, op) -> CArray:
+    """Apply one trace-IR op (ops/fuse.py) through the sharded primitives
+    — the per-gate fallback for ops that touch GLOBAL qubits, which the
+    fusion pass cannot fuse (their application is ppermute choreography,
+    not a slab pass). Fully-local runs of the trace are fused and applied
+    on the local shard instead (parallel.circuit._apply_ops_sharded):
+    lane fusion is sharding-oblivious — the 7 lane qubits are the last 7,
+    always local at any sharded width — and row-pair fusion is restricted
+    to local qubits by construction."""
+    from qfedx_tpu.ops import fuse
+
+    if op.kind == "g1":
+        return apply_gate_sharded(ctx, state, op.coeffs, op.qubits[0])
+    if op.kind == "cnot":
+        return apply_cnot_sharded(ctx, state, *op.qubits)
+    if op.kind == "g2":
+        return apply_gate_2q_sharded(ctx, state, op.coeffs, *op.qubits)
+    if op.kind == "diag1":
+        return apply_gate_sharded(
+            ctx, state, fuse.diag1_gate(op.coeffs), op.qubits[0]
+        )
+    if op.kind == "diag2":
+        return apply_gate_2q_sharded(
+            ctx, state, fuse.diag2_gate(op.coeffs), *op.qubits
+        )
+    raise ValueError(f"unknown IR op kind {op.kind!r}")
+
+
 # --- noise channels (stochastic Kraus trajectories) -------------------------
 
 

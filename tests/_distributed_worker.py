@@ -1,0 +1,101 @@
+"""Subprocess worker for the 2-process ``jax.distributed`` parity test.
+
+Run as: ``python tests/_distributed_worker.py <coordinator> <nproc> <pid>
+<out_path>``. Each process owns ONE XLA:CPU device; cross-process CPU
+collectives use the gloo backend (``jax_cpu_collectives_implementation``
+— must be set before ``jax.distributed.initialize``). Initialization
+goes through ``parallel.mesh.distributed_init`` — the wrapper the
+multi-host story ships — then one federated round runs over the
+2-process global mesh and process 0 writes the resulting parameters +
+stats for the parent to compare against the single-process oracle.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, nproc, pid, out_path = sys.argv[1:5]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # The parent test env forces 8 virtual devices; this worker must own
+    # exactly one device so the mesh spans the PROCESS boundary.
+    os.environ.pop("XLA_FLAGS", None)
+
+    import jax
+
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    # distributed_init must run BEFORE the first backend touch, but
+    # importing the qfedx_tpu package initializes the backend as a side
+    # effect (ops.gates builds concrete gate constants at import time).
+    # Load parallel/mesh.py directly — same code object, no package
+    # __init__ — call distributed_init, THEN import the framework.
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_qfedx_mesh", os.path.join(repo, "qfedx_tpu", "parallel", "mesh.py")
+    )
+    mesh_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mesh_mod)
+    mesh_mod.distributed_init(
+        coordinator_address=coordinator,
+        num_processes=int(nproc),
+        process_id=int(pid),
+    )
+    assert len(jax.devices()) == int(nproc), jax.devices()
+    assert len(jax.local_devices()) == 1
+
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.fed.round import make_fed_round
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    num_clients, samples, n_q = 2, 8, 3
+    cfg = FedConfig(local_epochs=2, batch_size=4, learning_rate=0.1,
+                    optimizer="adam")
+    model = make_vqc_classifier(n_qubits=n_q, n_layers=2, num_classes=2)
+
+    # Deterministic data/keys: every process builds identical host values
+    # (the multi-controller contract), then materializes GLOBAL arrays —
+    # client-sharded inputs span both processes' devices, so they must be
+    # assembled shard-by-shard, not device_put from one host.
+    rng = np.random.default_rng(0)
+    cx = rng.uniform(0, 1, (num_clients, samples, n_q)).astype(np.float32)
+    cy = rng.integers(0, 2, (num_clients, samples)).astype(np.int32)
+    cm = np.ones((num_clients, samples), dtype=np.float32)
+
+    mesh = Mesh(np.array(jax.devices()), ("clients",))
+
+    def globalize(x, spec):
+        return jax.make_array_from_callback(
+            x.shape, NamedSharding(mesh, spec), lambda idx: x[idx]
+        )
+
+    params = jax.tree.map(
+        lambda p: globalize(np.asarray(p), P()),
+        model.init(jax.random.PRNGKey(0)),
+    )
+    key = globalize(np.asarray(jax.random.PRNGKey(42)), P())
+    scx = globalize(cx, P("clients"))
+    scy = globalize(cy, P("clients"))
+    scm = globalize(cm, P("clients"))
+
+    round_fn = make_fed_round(model, cfg, mesh, num_clients=num_clients)
+    new_params, stats = round_fn(params, scx, scy, scm, key)
+
+    if int(pid) == 0:
+        leaves = {
+            f"leaf{i}": np.asarray(l)
+            for i, l in enumerate(jax.tree.leaves(new_params))
+        }
+        leaves["mean_loss"] = np.asarray(stats.mean_loss)
+        leaves["total_weight"] = np.asarray(stats.total_weight)
+        np.savez(out_path, **leaves)
+    print(f"worker {pid} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

