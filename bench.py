@@ -39,6 +39,11 @@ Sections in ``bench_details.json`` (beyond the headline):
 - ``time_to_target`` / ``time_to_target_20q``: wall-clock to target
   accuracy, flagship 8q config and the TRUE 20-qubit config-5 width
   (VERDICT r04 missing 1: 20q had been timed but never trained).
+- ``phase_breakdown`` (inside ``time_to_target``, compact copy on the
+  printed line): per-phase span rollup of the traced hot run
+  (qfedx_tpu/obs, QFEDX_TRACE) — dispatch / eval / trace-build /
+  compile walls, so ``vs_prev`` localizes a headline regression to a
+  phase automatically (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -468,7 +473,7 @@ def _bench_fusion_hlo(jax):
     compiled-module pass counts are the chip-side follow-up via
     benchmarks/profile_step.py."""
     from benchmarks._util import build_step
-    from benchmarks.profile_step import count_state_ops
+    from qfedx_tpu.obs.hlo import count_state_ops
 
     out = {}
     for n, batch in ((16, 64), (18, 16), (20, 8)):
@@ -568,8 +573,22 @@ def _bench_time_to_target(jax, target=0.90, max_rounds=40):
         return res, time.perf_counter() - t0
 
     _, cold_total = one_run()
-    res, total = one_run()
-    out = {"target_accuracy": target}
+
+    # The hot run is TRACED (QFEDX_TRACE is a per-call host guard, not
+    # trace-time routing, so with_env covers the whole run): the
+    # phase_breakdown below localizes a future regression of this row to
+    # dispatch vs eval vs trace-build vs compile instead of requiring a
+    # §11-style forensic pass. Span overhead is a few host µs per round —
+    # inside this row's run-to-run noise.
+    def hot_traced():
+        from qfedx_tpu import obs
+
+        obs.reset()
+        res, total = one_run()
+        return res, total, obs.phase_rollup()
+
+    res, total, rollup = _with_env({"QFEDX_TRACE": "1"}, hot_traced)
+    out = {"target_accuracy": target, "phase_breakdown": rollup}
     out.update(_target_hits(res.accuracies, res.round_times_s, target))
     out["timing"] = "hot (2nd run; cold wall kept alongside)"
     out[f"total_s_{max_rounds}_rounds"] = round(total, 3)
@@ -856,6 +875,26 @@ def main():
                   prev_engine_s("dense18q", "n18"), False)
             delta("dense20q_fwd_grad_s", dense20.get("fwd_grad_s"),
                   prev_engine_s("dense20q", "n20"), False)
+            # Per-phase drift of the traced time_to_target run: the prev
+            # printed line carries {phase: total_s}, so a regression in
+            # the headline localizes to a phase right here in vs_prev
+            # instead of needing a post-hoc forensic pass.
+            prev_pb = prev.get("phase_breakdown")
+            now_pb = {
+                k: v.get("total_s")
+                for k, v in ((ttt or {}).get("phase_breakdown") or {}).items()
+                if isinstance(v, dict)
+            }
+            if isinstance(prev_pb, dict) and now_pb:
+                vs_prev["phase_breakdown"] = {
+                    ph: {
+                        "prev": prev_pb[ph],
+                        "now": now_pb[ph],
+                        "ratio": round(now_pb[ph] / prev_pb[ph], 3),
+                    }
+                    for ph in sorted(set(prev_pb) & set(now_pb))
+                    if isinstance(prev_pb[ph], (int, float)) and prev_pb[ph]
+                }
             prev_ttt = prev.get("time_to_target") or {}
             if prev_ttt.get("timing", "").startswith("hot"):
                 delta("time_to_target_s", (ttt or {}).get("seconds"),
@@ -962,6 +1001,17 @@ def main():
                 else None,
                 "time_to_target": ttt_brief(ttt),
                 "time_to_target_20q": ttt_brief(ttt20),
+                # Compact {phase: total_s} of the traced hot
+                # time_to_target run — the artifact next round's vs_prev
+                # phase diff reads (full rollup in bench_details.json).
+                "phase_breakdown": {
+                    k: v.get("total_s")
+                    for k, v in (
+                        (ttt or {}).get("phase_breakdown") or {}
+                    ).items()
+                    if isinstance(v, dict)
+                }
+                or None,
                 "regressed": regressed,
                 "details": "bench_details.json" if sidecar else None,
             }

@@ -1,0 +1,62 @@
+"""Lint: no bare ``print()`` inside ``qfedx_tpu/`` outside the CLI/demo.
+
+Telemetry goes through ``obs`` (spans/counters) and ``run/metrics``
+(JSONL artifacts); progress text goes through the primary-gated ``say``
+in ``run/cli.py``. A stray ``print`` in library code interleaves across
+multi-host pods (utils/host.py docstring) and is invisible to every
+exporter — the reference's whole observability story was prints, which
+is exactly what this repo replaces (run/metrics.py docstring).
+
+AST-based (string literals and docstrings mentioning print are fine);
+wired as a tier-1 test in tests/test_no_print.py and runnable
+standalone: ``python benchmarks/check_no_print.py`` exits non-zero with
+offender ``path:line`` lines.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+# Files whose job is terminal output: the argparse CLI (primary-gated
+# ``say``) and the walkthrough demo script.
+ALLOWED = {"run/cli.py", "run/demo.py"}
+
+
+def find_prints(package_root: str | Path | None = None) -> list[str]:
+    """``["rel/path.py:lineno", ...]`` of bare print() calls under
+    ``package_root`` (default: the qfedx_tpu package next to this
+    repo's benchmarks/), excluding ALLOWED."""
+    if package_root is None:
+        package_root = Path(__file__).resolve().parent.parent / "qfedx_tpu"
+    root = Path(package_root)
+    offenders: list[str] = []
+    for py in sorted(root.rglob("*.py")):
+        rel = py.relative_to(root).as_posix()
+        if rel in ALLOWED or "__pycache__" in rel:
+            continue
+        tree = ast.parse(py.read_text(), filename=str(py))
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                offenders.append(f"{rel}:{node.lineno}")
+    return offenders
+
+
+def main() -> int:
+    offenders = find_prints()
+    if offenders:
+        print("bare print() in qfedx_tpu/ (route through obs/metrics/say):")
+        for off in offenders:
+            print(f"  qfedx_tpu/{off}")
+        return 1
+    print("ok: no bare print() outside run/cli.py, run/demo.py")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

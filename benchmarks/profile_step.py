@@ -33,6 +33,12 @@ from benchmarks._util import (
     timed_median,
 )
 
+# The HLO state-sized-op census lives in the package proper now
+# (qfedx_tpu/obs/hlo.py — importable observability primitive, shared
+# with bench.py's fusion_hlo section and the tier-1 regression test);
+# re-exported here so existing callers keep working.
+from qfedx_tpu.obs.hlo import count_state_ops, module_counts  # noqa: E402,F401
+
 
 def parse_trace(trace_dir):
     """Aggregate device-op durations from the newest trace.json.gz."""
@@ -94,69 +100,6 @@ def group_ops(by_op):
             key = "other"
         buckets[key] += t
     return buckets
-
-
-_TENSOR_RE = None
-
-
-def count_state_ops(txt: str, min_elems: int) -> dict:
-    """Count StableHLO ops by whether they TOUCH a state-sized tensor —
-    any operand or result type on the op line with ≥ ``min_elems``
-    elements, i.e. one traversal of a state-sized buffer (an HBM pass) —
-    vs trace-time-small ops (gate/coefficient/matrix-composition
-    arithmetic: 128×128 lane-matrix builds, 4×4 krons, iota masks —
-    bytes, not passes). Scanning every type on the line matters: a
-    scalar-result ``reduce`` still reads a state-sized operand, and a
-    ``broadcast_in_dim`` from a scalar still writes a state-sized
-    result; either is a pass. The fusion pass's claim is about the
-    state-sized count: raw op totals actually grow slightly under fusion
-    (the compositions add tiny ops) while state-sized ops — the
-    HBM-round-trip and scheduling-slot proxy PERF.md §11's floor model
-    prices — drop."""
-    global _TENSOR_RE
-    import re
-
-    if _TENSOR_RE is None:
-        _TENSOR_RE = re.compile(r"tensor<([0-9]+(?:x[0-9]+)*)x?[a-z]")
-    total, state = 0, 0
-    for ln in txt.splitlines():
-        if "= stablehlo." not in ln:
-            continue
-        total += 1
-        biggest = 0
-        for m in _TENSOR_RE.finditer(ln):
-            elems = 1
-            for d in m.group(1).split("x"):
-                elems *= int(d)
-            biggest = max(biggest, elems)
-        if biggest >= min_elems:
-            state += 1
-    return {"lowered_ops": total, "lowered_state_ops": state}
-
-
-def module_counts(fn, params, n_qubits, compiled=True):
-    """Op counts of the step program at two altitudes: the LOWERED
-    (StableHLO) module — split into state-sized vs small ops (see
-    count_state_ops; the state-sized count is what the fusion pass
-    shrinks), backend-independent given pinned routing — and the
-    COMPILED module: optimized-HLO instruction count plus the number of
-    ``fusion`` computations, a proxy for scheduled passes per step
-    (PERF.md §11's floor is ~one scheduling bubble per op).
-    ``compiled=False`` skips the backend compile — required off-chip,
-    where XLA:CPU compiles the unfused flip-form program pathologically
-    slowly (PERF.md §3b)."""
-    lowered = fn.lower(params)
-    out = count_state_ops(lowered.as_text(), 1 << n_qubits)
-    if not compiled:
-        return out
-    try:
-        ctxt = lowered.compile().as_text()
-        lines = [ln for ln in ctxt.splitlines() if " = " in ln]
-        out["compiled_instructions"] = len(lines)
-        out["compiled_fusions"] = sum(1 for ln in lines if " fusion(" in ln)
-    except Exception as e:  # noqa: BLE001 — counts must not kill profiling
-        out["compile_error"] = f"{type(e).__name__}: {e}"
-    return out
 
 
 def run_hlo_counts(args):

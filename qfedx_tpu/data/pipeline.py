@@ -189,6 +189,17 @@ def preprocess(
     then flattens; "pool" chunk-averages the flat image; "pca" standardizes
     + projects (quantum path; ROADMAP.md:19).
     """
+    from qfedx_tpu import obs
+
+    with obs.span("data.preprocess", features=features):
+        return _preprocess(
+            train_xy, test_xy, classes, val_split, features, n_features, seed
+        )
+
+
+def _preprocess(
+    train_xy, test_xy, classes, val_split, features, n_features, seed
+) -> Preprocessed:
     (tx, ty), (ex, ey) = train_xy, test_xy
     if classes is not None:
         tx, ty = filter_classes(tx, ty, classes)

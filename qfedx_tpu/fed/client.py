@@ -103,12 +103,15 @@ def _make_dp_example_grad(model: Model, cfg: FedConfig):
         losses, grads = jax.vmap(
             lambda xi, yi, k: jax.value_and_grad(ex_loss)(params, xi, yi, k)
         )(xb, yb, ex_keys)
-        norms = jax.vmap(trees.global_norm)(grads)
-        factor = jnp.minimum(1.0, dp.clip_norm / jnp.maximum(norms, 1e-12)) * mb
-        clipped_sum = jax.tree.map(
-            lambda g: jnp.tensordot(factor, g, axes=1), grads
-        )
-        noise = trees.tree_random_normal(k_noise, params)
+        with jax.named_scope("dp_example_clip_noise"):
+            norms = jax.vmap(trees.global_norm)(grads)
+            factor = (
+                jnp.minimum(1.0, dp.clip_norm / jnp.maximum(norms, 1e-12)) * mb
+            )
+            clipped_sum = jax.tree.map(
+                lambda g: jnp.tensordot(factor, g, axes=1), grads
+            )
+            noise = trees.tree_random_normal(k_noise, params)
         lot = float(xb.shape[0])
         gmean = jax.tree.map(
             lambda s, z: (s + dp.noise_multiplier * dp.clip_norm * z) / lot,
@@ -176,9 +179,12 @@ def make_local_update(model: Model, cfg: FedConfig) -> Callable:
             def batch_body(carry, batch):
                 params, opt_state = carry
                 xb, yb, mb, bk = batch
-                loss, grads = grad_fn(params, global_params, xb, yb, mb, bk)
-                updates, opt_state = tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
+                with jax.named_scope("local_step"):
+                    loss, grads = grad_fn(
+                        params, global_params, xb, yb, mb, bk
+                    )
+                    updates, opt_state = tx.update(grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
                 return (params, opt_state), loss
 
             (params, opt_state), losses = jax.lax.scan(
@@ -274,11 +280,12 @@ def make_local_update_clients(model: Model, cfg: FedConfig) -> Callable:
             def batch_body(carry, batch):
                 cparams, opt_state = carry
                 xb, yb, mb = batch
-                (_, loss_c), grads = grad_fn(
-                    cparams, global_params, xb, yb, mb
-                )
-                updates, opt_state = tx.update(grads, opt_state, cparams)
-                cparams = optax.apply_updates(cparams, updates)
+                with jax.named_scope("local_step_folded"):
+                    (_, loss_c), grads = grad_fn(
+                        cparams, global_params, xb, yb, mb
+                    )
+                    updates, opt_state = tx.update(grads, opt_state, cparams)
+                    cparams = optax.apply_updates(cparams, updates)
                 return (cparams, opt_state), loss_c
 
             (cparams, opt_state), losses = jax.lax.scan(

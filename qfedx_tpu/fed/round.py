@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from qfedx_tpu import obs
 from qfedx_tpu.fed.client import make_local_update, make_local_update_clients
 from qfedx_tpu.fed.config import FedConfig
 from qfedx_tpu.fed.privacy import privatize
@@ -109,11 +110,20 @@ def make_fed_round(
         )
     block = num_clients // axis_size
 
+    # Phase seams below carry two kinds of names: ``jax.named_scope``
+    # tags the emitted ops so XLA-level profiles (--profile /
+    # jax.profiler.trace) attribute device time to
+    # sampling/local_update/dp/secure-agg/aggregate, and ``obs.span``
+    # (QFEDX_TRACE-gated, trace-time only — this function runs under
+    # jit) records where TRACE-BUILD wall goes, once per compile.
     def per_device(params, cx, cy, cmask, round_key):
         # Local block shapes: cx [block, S, ...]; params replicated.
         dev = jax.lax.axis_index(axis)
         client_ids = dev * block + jnp.arange(block)
-        part = participation_mask(round_key, num_clients, cfg.client_fraction)
+        with obs.span("fed.trace.sampling"), jax.named_scope("sampling"):
+            part = participation_mask(
+                round_key, num_clients, cfg.client_fraction
+            )
 
         train_key = jax.random.fold_in(round_key, 0x7A41)
         dp_key = jax.random.fold_in(round_key, 0xD9)
@@ -125,9 +135,10 @@ def make_fed_round(
             vmapped: param-sized trees, no slab states)."""
             if cfg.dp is not None:
                 if cfg.dp.mode == "client":
-                    delta = privatize(
-                        delta, cfg.dp, jax.random.fold_in(dp_key, cid)
-                    )
+                    with jax.named_scope("dp_clip_noise"):
+                        delta = privatize(
+                            delta, cfg.dp, jax.random.fold_in(dp_key, cid)
+                        )
                 # mode == "example": the update is already private (per-
                 # example clip+noise inside local steps, fed.client);
                 # clipping it again here would break the DP-SGD noise
@@ -141,29 +152,39 @@ def make_fed_round(
             weight = weight * part[cid]
             contrib = trees.tree_scale(delta, weight)
             if cfg.secure_agg:
-                if cfg.secure_agg_mode == "ring":
-                    mask = ring_mask(
-                        sa_key, cid, num_clients, delta, part,
-                        cfg.secure_agg_scale, cfg.secure_agg_neighbors,
-                    )
-                else:
-                    mask = client_mask(
-                        sa_key, cid, num_clients, delta, part, cfg.secure_agg_scale
-                    )
-                contrib = trees.tree_add(contrib, mask)
+                with jax.named_scope("secure_agg_mask"):
+                    if cfg.secure_agg_mode == "ring":
+                        mask = ring_mask(
+                            sa_key, cid, num_clients, delta, part,
+                            cfg.secure_agg_scale, cfg.secure_agg_neighbors,
+                        )
+                    else:
+                        mask = client_mask(
+                            sa_key, cid, num_clients, delta, part,
+                            cfg.secure_agg_scale,
+                        )
+                    contrib = trees.tree_add(contrib, mask)
             return contrib, weight, loss
 
         if folded:
             # Client axis folded into the engine batch: the whole block's
             # local training is ONE program (same per-client keys as the
             # vmap path — fold_in(train_key, cid)).
-            ckeys = jax.vmap(
-                lambda c: jax.random.fold_in(train_key, c)
-            )(client_ids)
-            deltas, ns, losses_c = local_update_c(params, cx, cy, cmask, ckeys)
-            contribs, weights, losses = jax.vmap(postprocess)(
-                client_ids, deltas, ns, losses_c
-            )
+            with obs.span(
+                "fed.trace.local_update", path="folded"
+            ), jax.named_scope("local_update"):
+                ckeys = jax.vmap(
+                    lambda c: jax.random.fold_in(train_key, c)
+                )(client_ids)
+                deltas, ns, losses_c = local_update_c(
+                    params, cx, cy, cmask, ckeys
+                )
+            with obs.span("fed.trace.postprocess"), jax.named_scope(
+                "privacy_postprocess"
+            ):
+                contribs, weights, losses = jax.vmap(postprocess)(
+                    client_ids, deltas, ns, losses_c
+                )
         else:
 
             def run_client(cid, x, y, m):
@@ -172,26 +193,34 @@ def make_fed_round(
                 )
                 return postprocess(cid, delta, n, loss)
 
-            contribs, weights, losses = jax.vmap(run_client)(
-                client_ids, cx, cy, cmask
-            )
+            # One vmap covers local update + privacy postprocess (the
+            # per-client program is a single trace on this path).
+            with obs.span(
+                "fed.trace.local_update", path="vmap"
+            ), jax.named_scope("local_update"):
+                contribs, weights, losses = jax.vmap(run_client)(
+                    client_ids, cx, cy, cmask
+                )
 
         # Reduce the local client block, then all-reduce across chips.
-        block_sum = jax.tree.map(lambda t: jnp.sum(t, axis=0), contribs)
-        update_sum = jax.lax.psum(block_sum, axis)
-        weight_sum = jax.lax.psum(jnp.sum(weights), axis)
-        loss_sum = jax.lax.psum(jnp.sum(weights * losses), axis)
-        n_part = jax.lax.psum(jnp.sum(part[client_ids]), axis)
+        with obs.span("fed.trace.aggregate"), jax.named_scope("aggregate"):
+            block_sum = jax.tree.map(lambda t: jnp.sum(t, axis=0), contribs)
+            update_sum = jax.lax.psum(block_sum, axis)
+            weight_sum = jax.lax.psum(jnp.sum(weights), axis)
+            loss_sum = jax.lax.psum(jnp.sum(weights * losses), axis)
+            n_part = jax.lax.psum(jnp.sum(part[client_ids]), axis)
 
-        denom = jnp.maximum(weight_sum, 1e-12)
-        new_params = jax.tree.map(
-            lambda p, u: (p + u / denom).astype(p.dtype), params, update_sum
-        )
-        stats = RoundStats(
-            mean_loss=loss_sum / denom,
-            total_weight=weight_sum,
-            num_participants=n_part,
-        )
+            denom = jnp.maximum(weight_sum, 1e-12)
+            new_params = jax.tree.map(
+                lambda p, u: (p + u / denom).astype(p.dtype),
+                params,
+                update_sum,
+            )
+            stats = RoundStats(
+                mean_loss=loss_sum / denom,
+                total_weight=weight_sum,
+                num_participants=n_part,
+            )
         return new_params, stats
 
     sharded = shard_map(

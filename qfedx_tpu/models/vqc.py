@@ -21,6 +21,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from qfedx_tpu import obs
 from qfedx_tpu.circuits.ansatz import (
     data_reuploading,
     hardware_efficient,
@@ -160,17 +161,24 @@ def make_vqc_classifier(
         )
         from qfedx_tpu.ops.cpx import state_dtype
 
-        a = params["ansatz"]
-        if encoding == "reupload":
-            state = data_reuploading_b(x, a)
-        else:
-            if encoding == "amplitude":
-                state = bstate_amplitude(x, state_dtype())
+        # obs.span here times the TRACE of the engine program (this code
+        # runs under jit tracing; zero entries on hot calls) — the
+        # "trace build" phase per engine route.
+        with obs.span("engine.trace", engine="batched", n_qubits=n_qubits):
+            a = params["ansatz"]
+            if encoding == "reupload":
+                state = data_reuploading_b(x, a)
             else:
-                state = bstate_product(angle_amplitudes(x * jnp.pi, basis))
-            state = hardware_efficient_b(state, n_qubits, a)
-        z = expect_z_all_b(state, n_qubits)[:, : params["readout"]["scale"].shape[0]]
-        return params["readout"]["scale"] * z + params["readout"]["bias"]
+                if encoding == "amplitude":
+                    state = bstate_amplitude(x, state_dtype())
+                else:
+                    state = bstate_product(
+                        angle_amplitudes(x * jnp.pi, basis)
+                    )
+                state = hardware_efficient_b(state, n_qubits, a)
+            k = params["readout"]["scale"].shape[0]
+            z = expect_z_all_b(state, n_qubits)[:, :k]
+            return params["readout"]["scale"] * z + params["readout"]["bias"]
 
     def apply(params, x):
         if _use_batched():
@@ -182,7 +190,8 @@ def make_vqc_classifier(
                 return eval_noise.noisy_logits(state, params["readout"], None)
             return z_logits(state, params["readout"])
 
-        return jax.vmap(one)(x)
+        with obs.span("engine.trace", engine="vmap", n_qubits=n_qubits):
+            return jax.vmap(one)(x)
 
     def _apply_batched_clients(cparams, x):
         """Client-folded forward: params leaves (C, …), x (C, B, feat) —
@@ -200,25 +209,26 @@ def make_vqc_classifier(
         )
         from qfedx_tpu.ops.cpx import state_dtype
 
-        c, bsz = x.shape[0], x.shape[1]
-        a = cparams["ansatz"]
-        if encoding == "reupload":
-            state = data_reuploading_cb(x, a)
-        else:
-            flat = x.reshape((c * bsz,) + x.shape[2:])
-            if encoding == "amplitude":
-                state = bstate_amplitude(flat, state_dtype())
+        with obs.span("engine.trace", engine="folded", n_qubits=n_qubits):
+            c, bsz = x.shape[0], x.shape[1]
+            a = cparams["ansatz"]
+            if encoding == "reupload":
+                state = data_reuploading_cb(x, a)
             else:
-                state = bstate_product(
-                    angle_amplitudes(flat * jnp.pi, basis)
-                )
-            state = hardware_efficient_cb(state, n_qubits, a)
-        k = cparams["readout"]["scale"].shape[-1]
-        z = expect_z_all_b(state, n_qubits)[:, :k].reshape(c, bsz, k)
-        return (
-            cparams["readout"]["scale"][:, None, :] * z
-            + cparams["readout"]["bias"][:, None, :]
-        )
+                flat = x.reshape((c * bsz,) + x.shape[2:])
+                if encoding == "amplitude":
+                    state = bstate_amplitude(flat, state_dtype())
+                else:
+                    state = bstate_product(
+                        angle_amplitudes(flat * jnp.pi, basis)
+                    )
+                state = hardware_efficient_cb(state, n_qubits, a)
+            k = cparams["readout"]["scale"].shape[-1]
+            z = expect_z_all_b(state, n_qubits)[:, :k].reshape(c, bsz, k)
+            return (
+                cparams["readout"]["scale"][:, None, :] * z
+                + cparams["readout"]["bias"][:, None, :]
+            )
 
     def apply_clients(cparams, x):
         # Same routing decision as ``apply``: the folded engine is a TPU

@@ -1,0 +1,58 @@
+"""Observability: spans, counters, exporters, HLO census.
+
+The per-phase window into a federated round (ISSUE r08 tentpole; see
+docs/OBSERVABILITY.md). Host-side phases time themselves with
+``obs.span``; jitted seams carry ``jax.named_scope`` names into XLA
+profiles; exporters merge spans into metrics.jsonl/summary.json and
+write Perfetto-loadable trace.json files.
+
+Usage::
+
+    from qfedx_tpu import obs
+
+    with obs.span("round.dispatch", round=rnd) as sp:
+        params, stats = round_fn(...)
+    obs.counter("fuse.ops_in", len(ops))
+    obs.write_chrome_trace(run_dir / "trace.json")
+
+Everything is a no-op unless ``QFEDX_TRACE=1`` (see trace.enabled).
+"""
+
+from qfedx_tpu.obs.export import (
+    chrome_trace_events,
+    phase_rollup,
+    phase_totals,
+    snapshot,
+    write_chrome_trace,
+)
+from qfedx_tpu.obs.hlo import count_state_ops, module_counts
+from qfedx_tpu.obs.trace import (
+    Span,
+    counter,
+    enabled,
+    gauge,
+    record_device_memory,
+    registry,
+    reset,
+    span,
+    xla_annotations_enabled,
+)
+
+__all__ = [
+    "Span",
+    "chrome_trace_events",
+    "count_state_ops",
+    "counter",
+    "enabled",
+    "gauge",
+    "module_counts",
+    "phase_rollup",
+    "phase_totals",
+    "record_device_memory",
+    "registry",
+    "reset",
+    "snapshot",
+    "span",
+    "write_chrome_trace",
+    "xla_annotations_enabled",
+]

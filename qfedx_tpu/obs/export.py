@@ -1,0 +1,156 @@
+"""Exporters for the obs registry: phase rollups + Chrome/Perfetto trace.
+
+Three sinks, per the observability contract:
+
+1. ``phase_rollup()`` — per-phase {count, total_s, p50_s, p95_s,
+   compile_s}: merged into ``metrics.jsonl`` rows by the trainer and
+   into ``summary.json`` by ``run.metrics.ExperimentRun.finish``.
+2. ``write_chrome_trace(path)`` — Chrome trace-event JSON ("X" complete
+   events, µs timestamps) loadable in Perfetto / chrome://tracing; the
+   ``--trace`` CLI flag writes one per run.
+3. ``snapshot()`` — raw spans/counters/gauges for programmatic
+   consumers (bench.py's ``phase_breakdown`` section uses
+   ``phase_rollup``; ``snapshot``/``phase_totals`` are the raw/compact
+   views for ad-hoc tooling).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from qfedx_tpu.obs.trace import Span, registry
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def phase_rollup(spans: list[Span] | None = None) -> dict[str, dict]:
+    """Aggregate spans by name → {count, total_s, p50_s, p95_s,
+    compile_s}, ordered by total_s descending (the expensive phase reads
+    first in summary.json)."""
+    spans = registry().spans if spans is None else spans
+    by_name: dict[str, list[Span]] = {}
+    for sp in spans:
+        by_name.setdefault(sp.name, []).append(sp)
+    rows = {}
+    for name, group in by_name.items():
+        durs = sorted(sp.duration for sp in group)
+        rows[name] = {
+            "count": len(group),
+            "total_s": round(sum(durs), 6),
+            "p50_s": round(_percentile(durs, 0.50), 6),
+            "p95_s": round(_percentile(durs, 0.95), 6),
+        }
+        compile_s = sum(sp.compile_s for sp in group)
+        if compile_s > 0:
+            rows[name]["compile_s"] = round(compile_s, 6)
+    return dict(sorted(rows.items(), key=lambda kv: -kv[1]["total_s"]))
+
+
+def phase_totals(spans: list[Span] | None = None) -> dict[str, float]:
+    """Compact {phase: total_s} view — small enough for bench.py's
+    printed one-line JSON (the driver's captured artifact, which the
+    next round's vs_prev diff reads)."""
+    return {
+        name: row["total_s"] for name, row in phase_rollup(spans).items()
+    }
+
+
+def snapshot() -> dict:
+    """Raw registry contents as plain JSON-able data."""
+    reg = registry()
+    return {
+        "spans": [
+            {
+                "name": sp.name,
+                "t0": sp.t0 - reg.origin,
+                "dur_s": sp.duration,
+                "depth": sp.depth,
+                "compile_s": sp.compile_s,
+                "meta": sp.meta,
+            }
+            for sp in reg.spans
+        ],
+        "counters": dict(reg.counters),
+        "gauges": dict(reg.gauges),
+    }
+
+
+def chrome_trace_events(spans: list[Span] | None = None) -> list[dict]:
+    """Spans → Chrome trace-event list ("X" complete events). Timestamps
+    are µs since the registry origin (monotonic clock), one pid, tid per
+    originating thread — Perfetto renders the nesting from ts/dur."""
+    reg = registry()
+    spans = reg.spans if spans is None else spans
+    tids: dict[int, int] = {}
+    events: list[dict] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 1,
+            "tid": 0,
+            "args": {"name": "qfedx_tpu"},
+        }
+    ]
+    for sp in spans:
+        tid = tids.setdefault(sp.tid, len(tids))
+        args = {k: _jsonable_meta(v) for k, v in sp.meta.items()}
+        if sp.compile_s > 0:
+            args["compile_ms"] = round(sp.compile_s * 1e3, 3)
+        events.append(
+            {
+                "name": sp.name,
+                "ph": "X",
+                "ts": round((sp.t0 - reg.origin) * 1e6, 3),
+                "dur": round(sp.duration * 1e6, 3),
+                "pid": 1,
+                "tid": tid,
+                "args": args,
+            }
+        )
+    # Counters as one instant summary event at the end of the window.
+    if reg.counters or reg.gauges:
+        last = max(
+            (e["ts"] + e["dur"] for e in events if e["ph"] == "X"), default=0.0
+        )
+        events.append(
+            {
+                "name": "counters",
+                "ph": "i",
+                "s": "g",
+                "ts": last,
+                "pid": 1,
+                "tid": 0,
+                "args": {**reg.counters, **reg.gauges},
+            }
+        )
+    return events
+
+
+def _jsonable_meta(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+def write_chrome_trace(path: str | Path, spans: list[Span] | None = None) -> Path:
+    """Write the registry (or ``spans``) as a Chrome/Perfetto
+    ``trace.json``. Plain ``{"traceEvents": [...]}`` array-of-events
+    format — both viewers accept it."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(
+        json.dumps(
+            {
+                "traceEvents": chrome_trace_events(spans),
+                "displayTimeUnit": "ms",
+            }
+        )
+    )
+    return path

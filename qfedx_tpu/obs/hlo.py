@@ -1,0 +1,71 @@
+"""HLO op census: state-sized-op counts of a lowered/compiled program.
+
+Factored out of ``benchmarks/profile_step.py`` (which still re-exports
+it) so the census is an importable observability primitive: bench.py's
+``fusion_hlo`` section, the profile script, and the tier-1 regression
+test pinning the fused<unfused invariant all count ops through ONE
+definition.
+
+Raw op totals are the wrong metric — the fusion pass ADDS tiny
+matrix-composition ops while removing state passes — so the census
+splits lowered StableHLO ops by whether they touch a tensor of
+≥ 2^n elements (one HBM pass / scheduling slot, the thing docs/PERF.md
+§11's floor model prices) vs trace-time-small arithmetic.
+"""
+
+from __future__ import annotations
+
+import re
+
+_TENSOR_RE = re.compile(r"tensor<([0-9]+(?:x[0-9]+)*)x?[a-z]")
+
+
+def count_state_ops(txt: str, min_elems: int) -> dict:
+    """Count StableHLO ops by whether they TOUCH a state-sized tensor —
+    any operand or result type on the op line with ≥ ``min_elems``
+    elements, i.e. one traversal of a state-sized buffer (an HBM pass) —
+    vs trace-time-small ops (gate/coefficient/matrix-composition
+    arithmetic: 128×128 lane-matrix builds, 4×4 krons, iota masks —
+    bytes, not passes). Scanning every type on the line matters: a
+    scalar-result ``reduce`` still reads a state-sized operand, and a
+    ``broadcast_in_dim`` from a scalar still writes a state-sized
+    result; either is a pass."""
+    total, state = 0, 0
+    for ln in txt.splitlines():
+        if "= stablehlo." not in ln:
+            continue
+        total += 1
+        biggest = 0
+        for m in _TENSOR_RE.finditer(ln):
+            elems = 1
+            for d in m.group(1).split("x"):
+                elems *= int(d)
+            biggest = max(biggest, elems)
+        if biggest >= min_elems:
+            state += 1
+    return {"lowered_ops": total, "lowered_state_ops": state}
+
+
+def module_counts(fn, params, n_qubits, compiled=True):
+    """Op counts of a step program at two altitudes: the LOWERED
+    (StableHLO) module — split into state-sized vs small ops (see
+    ``count_state_ops``; the state-sized count is what the fusion pass
+    shrinks), backend-independent given pinned routing — and the
+    COMPILED module: optimized-HLO instruction count plus the number of
+    ``fusion`` computations, a proxy for scheduled passes per step
+    (docs/PERF.md §11's floor is ~one scheduling bubble per op).
+    ``compiled=False`` skips the backend compile — required off-chip,
+    where XLA:CPU compiles the unfused flip-form program pathologically
+    slowly (docs/PERF.md §3b)."""
+    lowered = fn.lower(params)
+    out = count_state_ops(lowered.as_text(), 1 << n_qubits)
+    if not compiled:
+        return out
+    try:
+        ctxt = lowered.compile().as_text()
+        lines = [ln for ln in ctxt.splitlines() if " = " in ln]
+        out["compiled_instructions"] = len(lines)
+        out["compiled_fusions"] = sum(1 for ln in lines if " fusion(" in ln)
+    except Exception as e:  # noqa: BLE001 — counts must not kill profiling
+        out["compile_error"] = f"{type(e).__name__}: {e}"
+    return out

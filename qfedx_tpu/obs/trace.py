@@ -1,0 +1,282 @@
+"""Process-local spans, counters and gauges — the tracing core.
+
+Why this exists: the only windows into a federated round used to be
+end-to-end wall timings (bench.py) and post-hoc HLO censuses
+(obs/hlo.py) — the r05 "7.7→15.7s regression" took a full forensic pass
+(docs/PERF.md §11) to attribute to a cold compile hiding inside the
+first scanned chunk, precisely because nothing recorded *where* time
+went inside a round. This module records it:
+
+- ``span("phase")``: context manager timing a host-side phase. Spans
+  nest (a thread-local stack tracks the parent), carry arbitrary
+  ``**meta``, and accumulate any JAX compile time that fires while they
+  are open (see below). Inside a jitted function a span times the
+  TRACE of that region — zero entries on hot calls — which is exactly
+  the "trace build" phase the engines report.
+- ``counter(name, inc)`` / ``gauge(name, value)``: process totals /
+  last-value samples.
+- JAX compile attribution: a ``jax.monitoring`` duration listener adds
+  ``/jax/core/compile/*`` durations to the innermost OPEN span
+  (``Span.compile_s``) and to global counters, so a cold compile is
+  attributed to the phase that triggered it instead of silently
+  inflating round 1 (the r05 failure mode).
+
+Cost model: everything gates on the ``QFEDX_TRACE`` env pin (default
+OFF). Unlike the engine pins (QFEDX_FUSE, QFEDX_FOLD_CLIENTS — read at
+trace time), QFEDX_TRACE is read per call: it guards host-side Python,
+not program structure, so toggling mid-process works and the disabled
+path is one env read + one branch (~3.5 µs; measured in docs/PERF.md
+§13). ``QFEDX_TRACE_XLA=1`` additionally opens a
+``jax.profiler.TraceAnnotation`` per span so XLA-level profiles
+(``jax.profiler.trace`` / run --profile) carry the same phase names.
+
+Multi-host note: the registry is process-local by design. Exporters run
+through ``run/`` paths that already gate on ``utils.host.is_primary``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any
+
+
+def enabled() -> bool:
+    """Is tracing on? QFEDX_TRACE pin: '1'/'on' or '0'/'off', default
+    off. Read per call (host-side guard, not trace-time routing)."""
+    env = os.environ.get("QFEDX_TRACE")
+    if env is None:
+        return False
+    if env not in ("0", "1", "on", "off"):
+        # A typo would silently disable every span — the wrong-path
+        # error class the other QFEDX_* pins also reject loudly.
+        raise ValueError(f"QFEDX_TRACE={env!r}: expected '1'/'on' or '0'/'off'")
+    return env in ("1", "on")
+
+
+def xla_annotations_enabled() -> bool:
+    """Opt-in bridge: mirror each span as a jax.profiler.TraceAnnotation
+    so XLA-level profiles carry the phase names. Off by default — the
+    annotation costs a C++ call per span even outside a profiler trace."""
+    return os.environ.get("QFEDX_TRACE_XLA") in ("1", "on")
+
+
+class Span:
+    """One finished (or open) phase interval. Times are
+    ``time.perf_counter()`` seconds, so only differences and ordering
+    are meaningful; exporters rebase onto the registry origin."""
+
+    __slots__ = ("name", "t0", "t1", "depth", "parent", "tid", "meta", "compile_s")
+
+    def __init__(self, name: str, meta: dict | None = None):
+        self.name = name
+        self.t0 = 0.0
+        self.t1 = 0.0
+        self.depth = 0
+        self.parent: "Span | None" = None
+        self.tid = 0
+        self.meta = meta or {}
+        self.compile_s = 0.0
+
+    @property
+    def duration(self) -> float:
+        return max(0.0, self.t1 - self.t0)
+
+    def set(self, **meta: Any) -> None:
+        self.meta.update(meta)
+
+    def __repr__(self) -> str:  # debugging aid only
+        return f"Span({self.name!r}, {self.duration * 1e3:.2f}ms, depth={self.depth})"
+
+
+class _NullSpan:
+    """Returned by ``span()`` when tracing is off: same surface, no
+    state. A single shared instance — the disabled path allocates
+    nothing."""
+
+    __slots__ = ()
+    name = ""
+    duration = 0.0
+    compile_s = 0.0
+    meta: dict = {}
+
+    def set(self, **meta: Any) -> None:
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Registry:
+    """Process-local store of finished spans + counters + gauges."""
+
+    def __init__(self):
+        self.spans: list[Span] = []
+        self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}
+        self.origin = time.perf_counter()
+        self._local = threading.local()
+        self._lock = threading.Lock()
+
+    def stack(self) -> list[Span]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def add_span(self, sp: Span) -> None:
+        with self._lock:
+            self.spans.append(sp)
+
+    def add_counter(self, name: str, inc: float) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0.0) + inc
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = value
+
+
+_REGISTRY = _Registry()
+
+
+def registry() -> _Registry:
+    return _REGISTRY
+
+
+def reset() -> None:
+    """Drop all recorded spans/counters/gauges and rebase the time
+    origin (bench scenarios and tests isolate themselves with this)."""
+    global _REGISTRY
+    _REGISTRY = _Registry()
+
+
+# --- compile-event attribution ------------------------------------------------
+
+_listener_installed = False
+
+
+def _on_event_duration(event: str, duration: float, **_kw) -> None:
+    """jax.monitoring duration listener: attribute compile time to the
+    innermost open span. Installed once, checks ``enabled()`` itself —
+    jax.monitoring has no unregister API."""
+    if "/compile/" not in event or not enabled():
+        return
+    reg = _REGISTRY
+    # Short tail of the event path: backend_compile_duration → backend_compile.
+    kind = event.rsplit("/", 1)[-1].replace("_duration", "")
+    reg.add_counter(f"compile.{kind}_s", duration)
+    stack = reg.stack()
+    if stack:
+        stack[-1].compile_s += duration
+    else:
+        reg.add_counter("compile.unattributed_s", duration)
+
+
+def _install_listener() -> None:
+    global _listener_installed
+    if _listener_installed:
+        return
+    _listener_installed = True
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+    except Exception:  # noqa: BLE001 — older jax: spans still work, no attribution
+        pass
+
+
+# --- public API ---------------------------------------------------------------
+
+
+class span:
+    """``with obs.span("round.dispatch", round=3) as sp:`` — times the
+    block, records it in the process registry, attributes any JAX
+    compile that fires inside it. No-op (shared null span) when
+    QFEDX_TRACE is off."""
+
+    __slots__ = ("_name", "_meta", "_sp", "_annot")
+
+    def __init__(self, name: str, **meta: Any):
+        self._name = name
+        self._meta = meta
+        self._sp: Span | None = None
+        self._annot = None
+
+    def __enter__(self):
+        if not enabled():
+            return _NULL_SPAN
+        _install_listener()
+        reg = _REGISTRY
+        sp = Span(self._name, dict(self._meta))
+        stack = reg.stack()
+        sp.depth = len(stack)
+        sp.parent = stack[-1] if stack else None
+        sp.tid = threading.get_ident()
+        if xla_annotations_enabled():
+            try:
+                import jax
+
+                self._annot = jax.profiler.TraceAnnotation(self._name)
+                self._annot.__enter__()
+            except Exception:  # noqa: BLE001 — annotation is an optional bridge
+                self._annot = None
+        stack.append(sp)
+        sp.t0 = time.perf_counter()
+        self._sp = sp
+        return sp
+
+    def __exit__(self, *exc):
+        sp = self._sp
+        if sp is None:
+            return False
+        sp.t1 = time.perf_counter()
+        reg = _REGISTRY
+        stack = reg.stack()
+        if stack and stack[-1] is sp:
+            stack.pop()
+        elif sp in stack:  # unbalanced exit (exception skipped children)
+            del stack[stack.index(sp):]
+        if self._annot is not None:
+            try:
+                self._annot.__exit__(*exc)
+            except Exception:  # noqa: BLE001
+                pass
+        reg.add_span(sp)
+        return False
+
+
+def counter(name: str, inc: float = 1.0) -> None:
+    """Accumulate a process-total counter (no-op when tracing is off)."""
+    if enabled():
+        _REGISTRY.add_counter(name, float(inc))
+
+
+def gauge(name: str, value: float) -> None:
+    """Record the latest value of a quantity (no-op when tracing is off)."""
+    if enabled():
+        _REGISTRY.set_gauge(name, float(value))
+
+
+def record_device_memory(prefix: str = "mem") -> dict | None:
+    """Sample device 0's allocator stats into gauges
+    (``{prefix}.bytes_in_use``, ``{prefix}.peak_bytes_in_use``) where
+    the backend exposes them (TPU/GPU; CPU returns None). Returns the
+    raw dict for callers that want it in a metrics row."""
+    if not enabled():
+        return None
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — stats are best-effort by contract
+        return None
+    if not stats:
+        return None
+    out = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        if key in stats:
+            out[key] = int(stats[key])
+            _REGISTRY.set_gauge(f"{prefix}.{key}", float(stats[key]))
+    return out or None

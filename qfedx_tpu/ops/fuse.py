@@ -56,6 +56,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from qfedx_tpu import obs
 from qfedx_tpu.ops import statevector as sv
 from qfedx_tpu.ops.cpx import CArray, RDTYPE, cmul
 from qfedx_tpu.ops.statevector import _LANE_BITS, _LANES, _SLAB_MIN
@@ -428,6 +429,11 @@ def fuse_ops(ops: list, n: int) -> list:
     flush_diag()
     flush_row()
     flush_lane()
+    # Trace-time telemetry: fuse_ops runs once per compile, so these
+    # count the emitted program, not hot executions (QFEDX_TRACE-gated).
+    obs.counter("fuse.passes")
+    obs.counter("fuse.ops_in", len(ops))
+    obs.counter("fuse.ops_out", len(out))
     return out
 
 

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from qfedx_tpu import obs
 from qfedx_tpu.circuits.ansatz import hea_layer_ops
 from qfedx_tpu.circuits.encoders import angle_amplitudes
 from qfedx_tpu.ops import fuse
@@ -45,9 +46,10 @@ def _apply_ops_sharded(ctx: ShardCtx, state, ops: list):
     Off-route this is exactly the old per-gate loop."""
     fused_route = fuse.fuse_active(ctx.n_local, min_width=_LANE_BITS)
     if not fused_route:
-        for op in ops:
-            state = apply_op_sharded(ctx, state, op)
-        return state
+        with obs.span("engine.trace", engine="sharded", ops=len(ops)):
+            for op in ops:
+                state = apply_op_sharded(ctx, state, op)
+            return state
 
     run: list = []
 
@@ -67,13 +69,18 @@ def _apply_ops_sharded(ctx: ShardCtx, state, ops: list):
             run.clear()
         return state
 
-    for op in ops:
-        if min(op.qubits) >= ctx.n_global:
-            run.append(op)
-        else:
-            state = flush(state)
-            state = apply_op_sharded(ctx, state, op)
-    return flush(state)
+    # Trace-time span (this runs under jit/shard_map tracing): records
+    # segment-and-fuse build cost; global-qubit barriers are counted so
+    # a trace shows how often the fused run is broken by communication.
+    with obs.span("engine.trace", engine="sharded", ops=len(ops)):
+        for op in ops:
+            if min(op.qubits) >= ctx.n_global:
+                run.append(op)
+            else:
+                obs.counter("sharded.global_barrier_ops")
+                state = flush(state)
+                state = apply_op_sharded(ctx, state, op)
+        return flush(state)
 
 
 def sharded_encoded_state(ctx: ShardCtx, features: jnp.ndarray, encoding: str):

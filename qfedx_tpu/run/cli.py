@@ -116,6 +116,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="save client-sample and class-distribution PNGs to the run dir")
     t.add_argument("--profile", action="store_true",
                    help="capture a jax.profiler trace of the training rounds into the run dir")
+    t.add_argument("--trace", action="store_true",
+                   help="record per-phase spans (sets QFEDX_TRACE=1): phase "
+                        "walls join every metrics.jsonl row, summary.json "
+                        "gets a phase_breakdown rollup, and a Perfetto/"
+                        "chrome://tracing-loadable trace.json lands in the "
+                        "run dir (docs/OBSERVABILITY.md)")
 
     d = sub.add_parser("demo", help="encoder walkthrough (reference testEncoder parity)")
     d.add_argument("--dataset", default="mnist",
@@ -202,10 +208,22 @@ def run_train(
     resume: bool = False,
     plots: bool = False,
     profile: bool = False,
+    trace: bool = False,
 ) -> dict:
+    import os
+
+    from qfedx_tpu import obs
     from qfedx_tpu.run.metrics import ExperimentRun
     from qfedx_tpu.run.trainer import train_federated
     from qfedx_tpu.utils.host import is_primary
+
+    if trace:
+        # QFEDX_TRACE is read per call (host-side guard, not trace-time
+        # routing), so setting it here covers the whole run including
+        # build_data below. reset() drops any import-time spans so the
+        # trace.json window is exactly this run.
+        os.environ["QFEDX_TRACE"] = "1"
+        obs.reset()
 
     # Multi-host: progress lines from every process interleave on shared
     # consoles; only process 0 speaks (artifacts are gated inside run/).
@@ -264,7 +282,8 @@ def run_train(
             )
         # result.evaluate is mesh-aware (sv-sharded models can't be
         # evaluated through bare model.apply).
-        test_metrics = result.evaluate(result.params, test_x, test_y)
+        with obs.span("final.eval"):
+            test_metrics = result.evaluate(result.params, test_x, test_y)
         summary = {
             "final_accuracy": test_metrics["accuracy"],
             "final_val_accuracy": result.final_accuracy if have_val else None,
@@ -279,6 +298,12 @@ def run_train(
             "final_epsilon": result.epsilons[-1] if result.epsilons else None,
         }
         run.finish(**summary)
+        if obs.enabled() and is_primary():
+            # Works for externally-set QFEDX_TRACE=1 too, not just
+            # --trace — the pin is the contract, the flag is sugar.
+            trace_path = obs.write_chrome_trace(run.dir / "trace.json")
+            say(f"[qfedx_tpu] phase trace: {trace_path} "
+                "(load in Perfetto / chrome://tracing)")
         say("[qfedx_tpu] " + json.dumps(summary))
         return summary
 
@@ -300,7 +325,8 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
     if args.cmd == "train":
         cfg = config_from_args(args)
-        run_train(cfg, resume=args.resume, plots=args.plots, profile=args.profile)
+        run_train(cfg, resume=args.resume, plots=args.plots,
+                  profile=args.profile, trace=args.trace)
     elif args.cmd == "demo":
         from qfedx_tpu.run.demo import run_demo
 

@@ -19,6 +19,7 @@ from typing import Any, Callable
 import jax
 import numpy as np
 
+from qfedx_tpu import obs
 from qfedx_tpu.fed.accountant import RDPAccountant
 from qfedx_tpu.fed.config import FedConfig
 from qfedx_tpu.fed.evaluate import make_evaluator
@@ -177,21 +178,23 @@ def train_federated(
 
     key = jax.random.PRNGKey(seed)
     init_key, round_key_base = jax.random.split(key)
-    params = model.init(init_key)
-    start_round = 0
-    if checkpointer is not None:
-        restored = checkpointer.restore_latest(params)
-        if restored is not None:
-            params, start_round = restored
+    with obs.span("trainer.init"):
+        params = model.init(init_key)
+        start_round = 0
+        if checkpointer is not None:
+            restored = checkpointer.restore_latest(params)
+            if restored is not None:
+                params, start_round = restored
 
-    scx, scy, scm = shard_client_data(mesh, cx, cy, cmask)
-    # Pre-place params with the replicated sharding the round emits;
-    # otherwise round 2's input layout differs from round 1's (plain arrays
-    # from init vs NamedSharding from the round output) and XLA compiles the
-    # whole program a second time.
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    with obs.span("trainer.shard_data"):
+        scx, scy, scm = shard_client_data(mesh, cx, cy, cmask)
+        # Pre-place params with the replicated sharding the round emits;
+        # otherwise round 2's input layout differs from round 1's (plain
+        # arrays from init vs NamedSharding from the round output) and XLA
+        # compiles the whole program a second time.
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-    params = jax.device_put(params, NamedSharding(mesh, P()))
+        params = jax.device_put(params, NamedSharding(mesh, P()))
     ex_dev = ey_dev = None
     if rounds_per_call > 1 and in_scan_eval:
         # Device-resident eval set for the scanned in-program eval;
@@ -267,7 +270,8 @@ def train_federated(
     # Round-0 (pre-training) accuracy — skipped when eval is effectively
     # off (eval_every > num_rounds), where it would only cost a compile.
     if eval_every <= num_rounds:
-        metrics0 = evaluate(params, test_x, test_y)
+        with obs.span("round.eval", round=0):
+            metrics0 = evaluate(params, test_x, test_y)
         result.accuracies.append(metrics0["accuracy"])
 
     rnd = start_round
@@ -288,27 +292,37 @@ def train_federated(
 
         t0 = time.perf_counter()
         scan_accs = None
-        if chunk > 1 and rounds_per_call > 1:
-            chunk_fn = get_chunk_fn(chunk)
-            if in_scan_eval:
-                params, (stats, accs) = chunk_fn(
-                    params, scx, scy, scm, round_key_base, rnd, ex_dev, ey_dev
-                )
-                jax.block_until_ready(params)
-                scan_accs = [float(a) for a in np.asarray(accs)]
+        # The dispatch span covers trace+compile+execute of the chunk's
+        # device program; a cold compile inside it is ATTRIBUTED here via
+        # the jax.monitoring listener (Span.compile_s) instead of
+        # silently inflating round 1 (the r05 forensic case, PERF.md §11).
+        with obs.span(
+            "round.dispatch", round=rnd + 1, chunk=chunk
+        ) as sp_dispatch:
+            if chunk > 1 and rounds_per_call > 1:
+                chunk_fn = get_chunk_fn(chunk)
+                if in_scan_eval:
+                    params, (stats, accs) = chunk_fn(
+                        params, scx, scy, scm, round_key_base, rnd,
+                        ex_dev, ey_dev,
+                    )
+                    jax.block_until_ready(params)
+                    scan_accs = [float(a) for a in np.asarray(accs)]
+                else:
+                    params, stats = chunk_fn(
+                        params, scx, scy, scm, round_key_base, rnd
+                    )
+                    jax.block_until_ready(params)
+                losses = [float(l) for l in np.asarray(stats.mean_loss)]
             else:
-                params, stats = chunk_fn(
-                    params, scx, scy, scm, round_key_base, rnd
-                )
+                losses = []
+                for i in range(chunk):
+                    round_key = jax.random.fold_in(round_key_base, rnd + i)
+                    params, stats = round_fn(
+                        params, scx, scy, scm, round_key
+                    )
+                    losses.append(float(stats.mean_loss))
                 jax.block_until_ready(params)
-            losses = [float(l) for l in np.asarray(stats.mean_loss)]
-        else:
-            losses = []
-            for i in range(chunk):
-                round_key = jax.random.fold_in(round_key_base, rnd + i)
-                params, stats = round_fn(params, scx, scy, scm, round_key)
-                losses.append(float(stats.mean_loss))
-            jax.block_until_ready(params)
         dt_per_round = (time.perf_counter() - t0) / chunk
 
         for i in range(chunk):
@@ -345,6 +359,7 @@ def train_federated(
                         "(Opacus/TF-privacy convention; not a strict "
                         "shuffle bound)"
                     )
+            sp_eval = sp_ckpt = None
             if scan_accs is not None:
                 # On-device eval came with the scanned dispatch: per-round
                 # accuracy at every round, no host round-trip, no
@@ -355,17 +370,39 @@ def train_federated(
                 metrics["accuracy"] = scan_accs[i]
                 metrics["eval_n"] = int(ex_dev.shape[0])
             elif (r + 1) % eval_every == 0 or r == num_rounds - 1:
-                eval_metrics = evaluate(params, test_x, test_y)
+                with obs.span("round.eval", round=r + 1) as sp_eval:
+                    eval_metrics = evaluate(params, test_x, test_y)
                 result.accuracies.append(eval_metrics["accuracy"])
                 metrics.update(eval_metrics)
             if checkpointer is not None:
                 # Always persist the final round — the weights
                 # final_accuracy is reported for must exist on disk even
                 # off the every-K cadence.
-                if r == num_rounds - 1:
-                    checkpointer.save(r + 1, params)
-                else:
-                    checkpointer.maybe_save(r + 1, params)
+                with obs.span("round.checkpoint", round=r + 1) as sp_ckpt:
+                    if r == num_rounds - 1:
+                        checkpointer.save(r + 1, params)
+                    else:
+                        checkpointer.maybe_save(r + 1, params)
+            if obs.enabled():
+                # Merge the round's phase walls into its metrics.jsonl
+                # row. dispatch/compile are per-chunk walls amortized to
+                # per-round shares (the scanned dispatch has no per-round
+                # boundary — same convention as time_s/chunk_rounds).
+                phases = {
+                    "dispatch_s": round(sp_dispatch.duration / chunk, 6)
+                }
+                if sp_dispatch.compile_s > 0:
+                    phases["compile_s"] = round(
+                        sp_dispatch.compile_s / chunk, 6
+                    )
+                if sp_eval is not None:
+                    phases["eval_s"] = round(sp_eval.duration, 6)
+                if sp_ckpt is not None:
+                    phases["checkpoint_s"] = round(sp_ckpt.duration, 6)
+                metrics["phases"] = phases
+                mem = obs.record_device_memory()
+                if mem and "bytes_in_use" in mem:
+                    metrics["mem_bytes_in_use"] = mem["bytes_in_use"]
             if on_round_end is not None:
                 on_round_end(r, metrics)
         rnd += chunk

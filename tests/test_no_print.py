@@ -1,0 +1,29 @@
+"""Tier-1 lint: telemetry goes through obs/metrics, not print().
+
+``benchmarks/check_no_print.py`` holds the single definition (AST scan,
+allowlist); this test wires it into the suite so a stray print() in
+library code fails CI, not a code review.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from benchmarks.check_no_print import ALLOWED, find_prints  # noqa: E402
+
+
+def test_no_bare_print_in_package():
+    offenders = find_prints()
+    assert offenders == [], (
+        "bare print() in qfedx_tpu/ — route telemetry through obs "
+        f"spans/counters or run/metrics JSONL: {offenders}"
+    )
+
+
+def test_allowlist_is_minimal():
+    # The allowlist names the two terminal-output entry points and
+    # nothing else; growing it should be a conscious diff here.
+    assert ALLOWED == {"run/cli.py", "run/demo.py"}

@@ -1,0 +1,248 @@
+"""Observability subsystem: spans, counters, exporters, trainer wiring.
+
+Covers the ISSUE r08 acceptance surface that is testable on CPU: the
+QFEDX_TRACE pin (default-off no-op path), span nesting/attribution,
+jax.monitoring compile attribution, the Chrome/Perfetto trace.json
+structure (schema + monotonic, nested intervals), and the trainer's
+per-round ``phases`` metrics + summary ``phase_breakdown`` rollup.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from qfedx_tpu import obs
+
+
+@pytest.fixture()
+def traced(monkeypatch):
+    """Fresh registry with tracing pinned on; leaves a clean registry."""
+    monkeypatch.setenv("QFEDX_TRACE", "1")
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# --- pin + disabled path -----------------------------------------------------
+
+
+def test_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("QFEDX_TRACE", raising=False)
+    obs.reset()
+    assert not obs.enabled()
+    with obs.span("phantom") as sp:
+        obs.counter("phantom.count")
+        obs.gauge("phantom.gauge", 3.0)
+    # Null span: shared no-op object, nothing recorded anywhere.
+    assert sp.duration == 0.0
+    sp.set(extra=1)  # no-op, must not raise
+    assert obs.registry().spans == []
+    assert obs.registry().counters == {}
+    assert obs.registry().gauges == {}
+
+
+def test_disabled_span_is_shared_singleton(monkeypatch):
+    monkeypatch.delenv("QFEDX_TRACE", raising=False)
+    with obs.span("a") as s1:
+        pass
+    with obs.span("b") as s2:
+        pass
+    assert s1 is s2  # the disabled path allocates nothing
+
+
+def test_pin_rejects_typos(monkeypatch):
+    monkeypatch.setenv("QFEDX_TRACE", "yes")
+    with pytest.raises(ValueError, match="QFEDX_TRACE"):
+        obs.enabled()
+
+
+def test_pin_off_values(monkeypatch):
+    for v in ("0", "off"):
+        monkeypatch.setenv("QFEDX_TRACE", v)
+        assert not obs.enabled()
+    for v in ("1", "on"):
+        monkeypatch.setenv("QFEDX_TRACE", v)
+        assert obs.enabled()
+
+
+# --- spans, counters, rollups ------------------------------------------------
+
+
+def test_span_nesting_and_meta(traced):
+    with obs.span("outer", round=1) as outer:
+        with obs.span("inner") as inner:
+            inner.set(items=3)
+    spans = obs.registry().spans
+    assert [s.name for s in spans] == ["inner", "outer"]  # closed in order
+    inner, outer = spans
+    assert inner.depth == 1 and outer.depth == 0
+    assert inner.parent is outer
+    assert outer.meta == {"round": 1} and inner.meta == {"items": 3}
+    # Monotonic + nested intervals.
+    assert outer.t1 >= outer.t0 and inner.t1 >= inner.t0
+    assert outer.t0 <= inner.t0 and inner.t1 <= outer.t1
+
+
+def test_counters_and_gauges(traced):
+    obs.counter("ops", 3)
+    obs.counter("ops", 4)
+    obs.gauge("mem", 10.0)
+    obs.gauge("mem", 20.0)
+    reg = obs.registry()
+    assert reg.counters["ops"] == 7.0
+    assert reg.gauges["mem"] == 20.0  # last value wins
+
+
+def test_phase_rollup(traced):
+    for _ in range(4):
+        with obs.span("a"):
+            pass
+    with obs.span("b"):
+        pass
+    roll = obs.phase_rollup()
+    assert set(roll) == {"a", "b"}
+    assert roll["a"]["count"] == 4 and roll["b"]["count"] == 1
+    for row in roll.values():
+        assert row["total_s"] >= row["p50_s"] >= 0.0
+        assert row["p95_s"] >= row["p50_s"]
+    totals = obs.phase_totals()
+    assert totals["a"] == roll["a"]["total_s"]
+
+
+def test_compile_time_attributed_to_open_span(traced):
+    import jax
+    import jax.numpy as jnp
+
+    offset = np.random.default_rng(0).uniform()  # defeat any jit cache
+
+    @jax.jit
+    def fresh(x):
+        return jnp.sin(x) * offset + 1.0
+
+    with obs.span("round.dispatch") as sp:
+        fresh(jnp.arange(8.0)).block_until_ready()
+    assert sp.compile_s > 0.0, "jax.monitoring compile events not attributed"
+    counters = obs.registry().counters
+    assert any(k.startswith("compile.") for k in counters)
+
+
+# --- chrome trace ------------------------------------------------------------
+
+
+def _validate_chrome_trace(path):
+    """Structural Perfetto/chrome://tracing contract: traceEvents list,
+    complete ("X") events with the required keys, non-negative monotonic
+    intervals, children nested inside their parents."""
+    obj = json.loads(path.read_text())
+    assert isinstance(obj["traceEvents"], list)
+    xs = [e for e in obj["traceEvents"] if e.get("ph") == "X"]
+    assert xs, "no complete events"
+    for e in xs:
+        for key in ("name", "ph", "ts", "dur", "pid", "tid"):
+            assert key in e, f"event missing {key}: {e}"
+        assert e["ts"] >= 0 and e["dur"] >= 0
+    return xs
+
+
+def test_write_chrome_trace_schema_and_nesting(traced, tmp_path):
+    with obs.span("round", round=1):
+        with obs.span("dispatch"):
+            pass
+        with obs.span("eval"):
+            pass
+    obs.counter("c", 2)
+    path = obs.write_chrome_trace(tmp_path / "trace.json")
+    xs = _validate_chrome_trace(path)
+    by_name = {e["name"]: e for e in xs}
+    assert set(by_name) == {"round", "dispatch", "eval"}
+    parent = by_name["round"]
+    for child in ("dispatch", "eval"):
+        c = by_name[child]
+        assert parent["ts"] <= c["ts"]
+        assert c["ts"] + c["dur"] <= parent["ts"] + parent["dur"] + 1e-3
+    # Counters ride along as an instant event; metadata names the process.
+    phs = {e.get("ph") for e in json.loads(path.read_text())["traceEvents"]}
+    assert "M" in phs and "i" in phs
+
+
+# --- trainer integration -----------------------------------------------------
+
+
+def test_trainer_emits_phases_and_rollup(traced, tmp_path):
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+    from qfedx_tpu.run.metrics import ExperimentRun
+    from qfedx_tpu.run.trainer import train_federated
+
+    model = make_vqc_classifier(n_qubits=2, n_layers=1, num_classes=2)
+    rng = np.random.default_rng(0)
+    cx = rng.uniform(0, 1, (4, 8, 2)).astype(np.float32)
+    cy = rng.integers(0, 2, (4, 8)).astype(np.int32)
+    cm = np.ones((4, 8), dtype=np.float32)
+    tx = rng.uniform(0, 1, (16, 2)).astype(np.float32)
+    ty = rng.integers(0, 2, 16).astype(np.int32)
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1)
+
+    rows = []
+    with ExperimentRun(tmp_path, "obs", config=cfg) as run:
+        res = train_federated(
+            model, cfg, cx, cy, cm, tx, ty, num_rounds=2,
+            on_round_end=lambda r, m: (rows.append(m), run.on_round_end(r, m)),
+        )
+        run.finish(final_accuracy=res.final_accuracy)
+
+    # Every metrics row carries its phase walls; dispatch dominates and
+    # the recorded phases stay within the row's measured wall.
+    assert len(rows) == 2
+    for row in rows:
+        phases = row["phases"]
+        assert phases["dispatch_s"] > 0
+        assert phases["dispatch_s"] <= row["time_s"] + 1e-6
+        assert phases["eval_s"] >= 0
+    # Round 1 triggered the XLA compile; the listener must attribute it
+    # to that round's dispatch, not let it hide in wall time (r05 bug).
+    assert rows[0]["phases"].get("compile_s", 0) > 0
+    assert "compile_s" not in rows[1]["phases"]
+
+    # Registry: trace-time spans from the jitted seams landed too.
+    names = {s.name for s in obs.registry().spans}
+    assert {"round.dispatch", "round.eval", "fed.trace.local_update",
+            "fed.trace.aggregate", "engine.trace"} <= names
+
+    # summary.json rollup (ExperimentRun.finish merges it when tracing).
+    summary = json.loads((run.dir / "summary.json").read_text())
+    pb = summary["phase_breakdown"]
+    assert pb["round.dispatch"]["count"] == 2
+    assert pb["round.dispatch"]["total_s"] > 0
+    # The JSONL rows parse and carry the same phases.
+    lines = [
+        json.loads(l)
+        for l in (run.dir / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert all("phases" in l for l in lines)
+
+    # And the whole run exports a loadable chrome trace.
+    path = obs.write_chrome_trace(tmp_path / "trace.json")
+    _validate_chrome_trace(path)
+
+
+def test_fuse_counters_via_engine(traced, monkeypatch):
+    """The fusion pass reports trace-time op counts when it runs."""
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("QFEDX_GATE_FORM", "flip")
+    monkeypatch.setenv("QFEDX_SLAB_LANES", "matmul")
+    monkeypatch.setenv("QFEDX_BATCHED", "1")
+    monkeypatch.setenv("QFEDX_FUSE", "1")
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    model = make_vqc_classifier(n_qubits=12, n_layers=1, num_classes=2)
+    params = model.init(jax.random.PRNGKey(0))
+    x = jnp.zeros((2, 12), jnp.float32)
+    jax.jit(model.apply).lower(params, x)  # trace only — no CPU compile
+    counters = obs.registry().counters
+    assert counters.get("fuse.passes", 0) >= 1
+    assert counters["fuse.ops_out"] < counters["fuse.ops_in"]
+    assert any(s.name == "engine.trace" for s in obs.registry().spans)

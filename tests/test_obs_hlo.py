@@ -1,0 +1,75 @@
+"""HLO census regression: the fusion pass must shrink state-sized ops.
+
+The census (qfedx_tpu/obs/hlo.py, factored out of
+benchmarks/profile_step.py) counts lowered StableHLO ops that touch a
+≥2^n-element tensor — one HBM pass / scheduling slot each, the quantity
+the r07 fusion compiler exists to reduce (docs/PERF.md §12: 3089→2322
+at n=16 on the chip). This pins the invariant at n=12 on CPU: lowering
+only (fn.lower — backend-independent, cheap; the pathological XLA:CPU
+compile of flip programs is never entered), TPU production routing
+pinned via the env knobs.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from qfedx_tpu.obs.hlo import count_state_ops, module_counts  # noqa: E402
+
+_TPU_ROUTING = {
+    "QFEDX_GATE_FORM": "flip",
+    "QFEDX_SLAB_LANES": "matmul",
+    "QFEDX_BATCHED": "1",
+}
+
+
+def _state_ops(monkeypatch, fuse_pin: str, n=12, layers=2, batch=4) -> dict:
+    from benchmarks._util import build_step
+
+    for k, v in _TPU_ROUTING.items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.setenv("QFEDX_FUSE", fuse_pin)
+    fn, params, _ = build_step(n, layers, batch, steps=1)
+    return module_counts(fn, params, n, compiled=False)
+
+
+def test_fused_fewer_state_ops_than_unfused(monkeypatch):
+    fused = _state_ops(monkeypatch, "1")
+    unfused = _state_ops(monkeypatch, "off")
+    assert 0 < fused["lowered_state_ops"] < unfused["lowered_state_ops"], (
+        f"fusion no longer reduces state-sized ops: "
+        f"fused={fused['lowered_state_ops']} "
+        f"unfused={unfused['lowered_state_ops']}"
+    )
+    # Raw totals are NOT the metric (fusion adds tiny composition ops);
+    # the census must keep reporting both so nobody regresses to totals.
+    assert fused["lowered_ops"] > fused["lowered_state_ops"]
+
+
+def test_count_state_ops_scans_operands_and_results():
+    # A scalar-result reduce still READS a state-sized operand; a
+    # broadcast from a scalar still WRITES a state-sized result. Both
+    # must count — plus small ops must not.
+    txt = "\n".join(
+        [
+            '  %0 = stablehlo.reduce(%a) : (tensor<4096xf32>) -> tensor<f32>',
+            '  %1 = stablehlo.broadcast_in_dim %s : (tensor<f32>)'
+            ' -> tensor<2x4096xf32>',
+            '  %2 = stablehlo.add %x, %y : tensor<16x128xf32>',
+        ]
+    )
+    out = count_state_ops(txt, 1 << 12)
+    assert out == {"lowered_ops": 3, "lowered_state_ops": 2}
+
+
+def test_profile_step_reexports():
+    # Back-compat: existing callers import the census from the script.
+    from benchmarks import profile_step
+
+    assert profile_step.count_state_ops is count_state_ops
+    assert profile_step.module_counts is module_counts

@@ -70,6 +70,63 @@ def test_metrics_logger_jsonl(tmp_path):
     assert all("ts" in l for l in lines)
 
 
+def test_killed_writer_leaves_whole_json_lines(tmp_path):
+    """The crash-safety claim, enforced: a writer dying WITHOUT close()
+    or interpreter shutdown (os._exit skips flush/atexit — the OOM-kill/
+    SIGKILL shape) must leave every logged record as a complete JSON
+    line. Buffered writes silently break this (records sat in the
+    process buffer); MetricsLogger flushes + fsyncs per append."""
+    import subprocess
+    import sys
+
+    path = tmp_path / "m.jsonl"
+    code = (
+        "import os\n"
+        "from qfedx_tpu.run.metrics import MetricsLogger\n"
+        f"log = MetricsLogger({str(path)!r})\n"
+        "for i in range(3):\n"
+        "    log.log({'round': i + 1, 'loss': 0.5})\n"
+        "os._exit(1)\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], timeout=120)
+    assert proc.returncode == 1
+    lines = path.read_text().splitlines()
+    assert [json.loads(l)["round"] for l in lines] == [1, 2, 3]
+
+
+def test_agreed_run_dir_name_matrix(tmp_path):
+    """Single-process resume/collide matrix of the run-dir naming rule
+    (the multi-host broadcast path shares the collide semantics; its
+    agreement protocol is exercised by the distributed test)."""
+    import re
+
+    from qfedx_tpu.run.metrics import _agreed_run_dir_name
+
+    # Fresh name: used as-is whether or not this is a resume.
+    assert _agreed_run_dir_name(tmp_path, "exp", False) == "exp"
+    assert _agreed_run_dir_name(tmp_path, "exp", True) == "exp"
+    (tmp_path / "exp").mkdir()
+    # Collision + resume: reuse the existing dir (checkpoints live there).
+    assert _agreed_run_dir_name(tmp_path, "exp", True) == "exp"
+    # Collision + fresh run: timestamp-suffixed sibling, never the original.
+    stamped = _agreed_run_dir_name(tmp_path, "exp", False)
+    assert re.fullmatch(r"exp-\d{8}-\d{6}", stamped)
+
+
+def test_experiment_run_collision_and_resume_dirs(tmp_path):
+    cfg = FedConfig(local_epochs=1, batch_size=4)
+    with ExperimentRun(tmp_path, "dup", config=cfg) as r1:
+        r1.finish()
+    with ExperimentRun(tmp_path, "dup", config=cfg) as r2:
+        r2.finish()
+    assert r2.dir != r1.dir  # fresh run never clobbers the old artifacts
+    assert r1.dir.exists() and r2.dir.exists()
+    assert (r1.dir / "summary.json").exists()
+    with ExperimentRun(tmp_path, "dup", config=cfg, resume=True) as r3:
+        pass
+    assert r3.dir == r1.dir  # resume goes back to the ORIGINAL name
+
+
 def test_experiment_run_artifacts(tmp_path):
     cfg = FedConfig(local_epochs=1, batch_size=4)
     with ExperimentRun(tmp_path, "exp", config=cfg) as run:
