@@ -139,15 +139,18 @@ def with_env(env: dict, fn, *a, **k):
 
 def enable_cache(jax) -> None:
     """Point JAX's persistent compilation cache at the repo-local
-    .jax_cache dir (single definition — bench.py, fused_sweep.py and
-    profile_step.py all use this; the multi-minute Mosaic/XLA compiles
-    make every re-run hot)."""
-    try:
-        cache = os.path.join(
+    .jax_cache dir (bench.py, fused_sweep.py and profile_step.py all use
+    this; the multi-minute Mosaic/XLA compiles make every re-run hot).
+    The policy definition lives in qfedx_tpu.utils.cache (r09: the CLI
+    shares it behind QFEDX_COMPILE_CACHE) — this wrapper only supplies
+    the bench scripts' repo-local default directory, so the pin's
+    off/redirect values apply to bench runs too."""
+    from qfedx_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache(
+        jax,
+        default_dir=os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             ".jax_cache",
-        )
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-    except Exception:  # noqa: BLE001 — cache is an optimization only
-        pass
+        ),
+    )

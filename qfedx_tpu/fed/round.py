@@ -28,7 +28,6 @@ one scalar — the MB/round metric the roadmap wants tracked
 
 from __future__ import annotations
 
-import os
 from typing import NamedTuple
 
 import jax
@@ -43,7 +42,7 @@ from qfedx_tpu.fed.privacy import privatize
 from qfedx_tpu.fed.sampling import participation_mask
 from qfedx_tpu.fed.secure_agg import client_mask, ring_mask
 from qfedx_tpu.models.api import Model
-from qfedx_tpu.utils import trees
+from qfedx_tpu.utils import pins, trees
 from qfedx_tpu.utils.compat import shard_map
 
 
@@ -75,14 +74,34 @@ def fold_clients_enabled(model: Model, cfg: FedConfig) -> bool:
         and cfg.optimizer != "spsa"
         and not (cfg.dp is not None and cfg.dp.mode == "example")
     )
-    env = os.environ.get("QFEDX_FOLD_CLIENTS")
-    if env is not None:
-        if env not in ("0", "1"):
-            raise ValueError(
-                f"QFEDX_FOLD_CLIENTS={env!r}: expected '0' or '1'"
-            )
-        return eligible and env == "1"
-    return eligible
+    # Parse the pin unconditionally — a typo must raise even for configs
+    # where eligibility already decides (the loud-typo contract).
+    pinned = pins.bool_pin("QFEDX_FOLD_CLIENTS", True)
+    return eligible and pinned
+
+
+def donate_enabled() -> bool:
+    """Should the TRAINER donate the round-trip ``params`` buffer?
+
+    The round's only round-trip state at the jit boundary is θ (optimizer
+    state and statevector slabs live inside the program, where XLA
+    aliases them itself); donating it lets XLA write θ_new over θ's
+    buffer instead of copying per dispatch — the r09 pipeline issues
+    chunk k+1 from chunk k's device output, so without donation every
+    chunk pays one params copy and holds two live copies at the n=20
+    shapes. But donation DELETES the caller's input buffer, so it is
+    opt-in at the ``make_fed_round(s)`` boundary (default off — direct
+    callers, tests and bench included, routinely reuse a params buffer
+    after a round call); ``run/trainer.py``, which always chains θ
+    through outputs and snapshots before a donating dispatch when the
+    drain still needs it, opts in per THIS policy. ``QFEDX_DONATE``
+    (``0``/``off``/``1``/``on``) pins; the default follows the engine
+    pins' convention (fast on TPU/GPU, conservative on CPU). Read at
+    BUILD time — set it before ``make_fed_round``; results are
+    bit-identical either way (pinned in tests/test_pipeline.py)."""
+    return pins.bool_pin(
+        "QFEDX_DONATE", lambda: jax.default_backend() != "cpu"
+    )
 
 
 def make_fed_round(
@@ -91,12 +110,19 @@ def make_fed_round(
     mesh: Mesh,
     num_clients: int,
     axis: str = "clients",
+    donate: bool = False,
 ):
     """Build ``round_fn(params, cx, cy, cmask, round_key) -> (params, stats)``.
 
     ``cx/cy/cmask``: packed client data [C, S, ...] sharded over ``axis``;
     C must be divisible by the mesh axis size (block of C/D clients per
     device — SURVEY.md §7.3.5's inner vmap over a client block).
+    ``donate=True`` donates the ``params`` argument's buffer to the
+    dispatch — the caller's input arrays are DELETED on call; only pass
+    buffers you re-derive from the output. Default OFF: direct callers
+    commonly reuse a params buffer after a round call, which donation
+    would invalidate on accelerator backends. The trainer opts in via
+    ``donate_enabled()`` (the QFEDX_DONATE pin).
     """
     local_update = make_local_update(model, cfg)
     folded = fold_clients_enabled(model, cfg)
@@ -230,7 +256,7 @@ def make_fed_round(
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(sharded)
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
 def make_fed_rounds(
@@ -241,6 +267,7 @@ def make_fed_rounds(
     rounds_per_call: int,
     axis: str = "clients",
     with_eval: bool = False,
+    donate: bool = False,
 ):
     """K federated rounds in ONE dispatch: ``lax.scan`` over the round body.
 
@@ -257,6 +284,12 @@ def make_fed_rounds(
     leaf stacked over the K rounds. ``start_round`` may be a traced int32
     (no recompile across chunks).
 
+    ``donate=True`` donates the ``params`` argument's buffer — the
+    caller's input arrays are DELETED on call (see ``make_fed_round``,
+    whose default-off rationale applies here too). Donation lives on
+    THIS jit; the inner per-round jit is built non-donating because it
+    inlines under this trace, where a donate mark would be meaningless.
+
     ``with_eval=True`` (round-2 VERDICT item 6): evaluation joins the
     scanned program — ``rounds_fn(..., start_round, eval_x, eval_y) ->
     (params, (stats, accuracies))`` computes test accuracy ON DEVICE after
@@ -266,7 +299,10 @@ def make_fed_rounds(
     host-callable models (``model.sv_size == 1``); the sharded-VQC path
     keeps host-side evaluation via ``vqc_sharded.host_apply``.
     """
-    one_round = make_fed_round(model, cfg, mesh, num_clients, axis=axis)
+    one_round = make_fed_round(
+        model, cfg, mesh, num_clients, axis=axis, donate=False
+    )
+    donate_argnums = (0,) if donate else ()
 
     if with_eval:
         if model.sv_size != 1:
@@ -288,7 +324,7 @@ def make_fed_rounds(
                 body, params, jnp.arange(rounds_per_call, dtype=jnp.int32)
             )
 
-        return jax.jit(rounds_fn)
+        return jax.jit(rounds_fn, donate_argnums=donate_argnums)
 
     def rounds_fn(params, cx, cy, cmask, round_key_base, start_round):
         def body(p, i):
@@ -300,7 +336,7 @@ def make_fed_rounds(
             body, params, jnp.arange(rounds_per_call, dtype=jnp.int32)
         )
 
-    return jax.jit(rounds_fn)
+    return jax.jit(rounds_fn, donate_argnums=donate_argnums)
 
 
 def shard_client_data(mesh: Mesh, cx, cy, cmask, axis: str = "clients"):

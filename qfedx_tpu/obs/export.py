@@ -99,7 +99,21 @@ def chrome_trace_events(spans: list[Span] | None = None) -> list[dict]:
         }
     ]
     for sp in spans:
-        tid = tids.setdefault(sp.tid, len(tids))
+        if sp.tid not in tids:
+            tids[sp.tid] = len(tids)
+            # Name the track after the originating thread — the r09
+            # async checkpoint writer puts spans on a second thread, and
+            # an anonymous numeric track defeats the point of the trace.
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": 1,
+                    "tid": tids[sp.tid],
+                    "args": {"name": sp.tname or "thread"},
+                }
+            )
+        tid = tids[sp.tid]
         args = {k: _jsonable_meta(v) for k, v in sp.meta.items()}
         if sp.compile_s > 0:
             args["compile_ms"] = round(sp.compile_s * 1e3, 3)

@@ -36,30 +36,26 @@ through ``run/`` paths that already gate on ``utils.host.is_primary``.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Any
 
+from qfedx_tpu.utils import pins
+
 
 def enabled() -> bool:
     """Is tracing on? QFEDX_TRACE pin: '1'/'on' or '0'/'off', default
-    off. Read per call (host-side guard, not trace-time routing)."""
-    env = os.environ.get("QFEDX_TRACE")
-    if env is None:
-        return False
-    if env not in ("0", "1", "on", "off"):
-        # A typo would silently disable every span — the wrong-path
-        # error class the other QFEDX_* pins also reject loudly.
-        raise ValueError(f"QFEDX_TRACE={env!r}: expected '1'/'on' or '0'/'off'")
-    return env in ("1", "on")
+    off. Read per call (host-side guard, not trace-time routing); a typo
+    would silently disable every span, so the shared pin parser rejects
+    it loudly."""
+    return pins.bool_pin("QFEDX_TRACE", False)
 
 
 def xla_annotations_enabled() -> bool:
     """Opt-in bridge: mirror each span as a jax.profiler.TraceAnnotation
     so XLA-level profiles carry the phase names. Off by default — the
     annotation costs a C++ call per span even outside a profiler trace."""
-    return os.environ.get("QFEDX_TRACE_XLA") in ("1", "on")
+    return pins.bool_pin("QFEDX_TRACE_XLA", False)
 
 
 class Span:
@@ -67,7 +63,10 @@ class Span:
     ``time.perf_counter()`` seconds, so only differences and ordering
     are meaningful; exporters rebase onto the registry origin."""
 
-    __slots__ = ("name", "t0", "t1", "depth", "parent", "tid", "meta", "compile_s")
+    __slots__ = (
+        "name", "t0", "t1", "depth", "parent", "tid", "tname", "meta",
+        "compile_s",
+    )
 
     def __init__(self, name: str, meta: dict | None = None):
         self.name = name
@@ -76,6 +75,10 @@ class Span:
         self.depth = 0
         self.parent: "Span | None" = None
         self.tid = 0
+        # Originating thread's name: since r09 spans come from more than
+        # the main thread (checkpoint.async_write runs on the background
+        # writer), and the Chrome trace names its tracks from this.
+        self.tname = ""
         self.meta = meta or {}
         self.compile_s = 0.0
 
@@ -214,6 +217,7 @@ class span:
         sp.depth = len(stack)
         sp.parent = stack[-1] if stack else None
         sp.tid = threading.get_ident()
+        sp.tname = threading.current_thread().name
         if xla_annotations_enabled():
             try:
                 import jax

@@ -50,7 +50,6 @@ trace (see statevector._gate_form for the wrong-path-measured warning).
 
 from __future__ import annotations
 
-import os
 from typing import NamedTuple
 
 import jax
@@ -58,6 +57,7 @@ import jax.numpy as jnp
 
 from qfedx_tpu import obs
 from qfedx_tpu.ops import statevector as sv
+from qfedx_tpu.utils import pins
 from qfedx_tpu.ops.cpx import CArray, RDTYPE, cmul
 from qfedx_tpu.ops.statevector import _LANE_BITS, _LANES, _SLAB_MIN
 
@@ -95,19 +95,13 @@ def fuse_enabled() -> bool:
     slab/matmul programs (the TPU production path; on CPU the default
     engine is the tensordot form the fusions don't apply to). Read at
     trace time; like QFEDX_DTYPE, set it BEFORE the first trace."""
-    env = os.environ.get("QFEDX_FUSE")
-    if env is not None:
-        if env not in ("0", "1", "on", "off"):
-            # A typo would silently measure the other route — the
-            # wrong-path-measured error class (ADVICE r04 item 1).
-            raise ValueError(
-                f"QFEDX_FUSE={env!r}: expected '1'/'on' or '0'/'off'"
-            )
-        return env in ("1", "on")
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:  # noqa: BLE001 — no backend yet: conservative
-        return False
+    def _default() -> bool:
+        try:
+            return jax.default_backend() == "tpu"
+        except Exception:  # noqa: BLE001 — no backend yet: conservative
+            return False
+
+    return pins.bool_pin("QFEDX_FUSE", _default)
 
 
 def fuse_active(n_qubits: int, min_width: int = _SLAB_MIN) -> bool:

@@ -8,13 +8,30 @@ resume (reference ROADMAP.md:90-91). Here a checkpoint is a single
 treedef and round number — dependency-light, atomic (write-to-temp +
 rename), and restorable on any host/device topology since params are
 replicated in SPMD.
+
+r09: mid-run saves can run on a background writer thread
+(``save_async``) so a checkpoint boundary no longer drains the
+trainer's software pipeline — the device→host snapshot (the
+``np.asarray`` per leaf, which blocks until the donated/queued round
+actually finishes) happens off the round loop's critical path. The
+durability contract is unchanged: every write is still
+tmp-file + ``os.replace`` (a writer killed mid-write never corrupts the
+latest checkpoint — the async sibling of the r08 metrics-fsync test),
+the queue is bounded (one write in flight + one queued; a third
+``save_async`` blocks — checkpoints can lag the trainer by at most one
+boundary), and ``wait()`` joins outstanding writes and re-raises any
+writer error. Final-round saves stay SYNCHRONOUS in the trainer
+(wait + save) so the params the run reports exist on disk before
+``train_federated`` returns.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import queue as queue_mod
 import re
+import threading
 from pathlib import Path
 from typing import Any
 
@@ -48,6 +65,11 @@ class Checkpointer:
             self.dir.mkdir(parents=True, exist_ok=True)
         self.every = every
         self.keep = keep
+        # Background-writer state (spawned lazily by save_async; only the
+        # primary process ever writes, so only it ever owns a thread).
+        self._queue: queue_mod.Queue | None = None
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
 
     # -- save ----------------------------------------------------------------
 
@@ -75,6 +97,142 @@ class Checkpointer:
         if round_idx % self.every == 0:
             return self.save(round_idx, params)
         return None
+
+    # -- async save ----------------------------------------------------------
+
+    def _writer_loop(self) -> None:
+        from qfedx_tpu import obs
+
+        while True:
+            item = self._queue.get()
+            try:
+                if item is None:
+                    return  # shutdown sentinel (wait() retires the thread)
+                round_idx, params = item
+                # The np.asarray fetch inside save() blocks until the
+                # device finishes the rounds that produced ``params``
+                # — on THIS thread, off the trainer's dispatch path.
+                with obs.span("checkpoint.async_write", round=round_idx):
+                    self.save(round_idx, params)
+            except BaseException as e:  # noqa: BLE001 — surfaced by wait()
+                if self._error is None:  # keep the FIRST (root-cause) error
+                    self._error = e
+            finally:
+                self._queue.task_done()
+
+    def save_async(self, round_idx: int, params: Any) -> None:
+        """Queue ``save(round_idx, params)`` on the background writer.
+
+        Bounded at one write in flight + one queued: a third call blocks
+        until the writer catches up, so a slow filesystem backpressures
+        the trainer instead of accumulating unbounded device snapshots.
+        A prior writer error is raised here (or at ``wait()``), not
+        swallowed. Callers must pass params they will not donate/delete
+        afterwards (the trainer passes a device-side copy when the next
+        dispatch would consume the buffer).
+        """
+        if not is_primary():
+            return
+        self._raise_pending()
+        if self._queue is None:
+            self._queue = queue_mod.Queue(maxsize=1)
+            self._thread = threading.Thread(
+                target=self._writer_loop,
+                name="qfedx-ckpt-writer",
+                daemon=True,  # never blocks interpreter exit; trainer wait()s
+            )
+            self._thread.start()
+        self._queue.put((round_idx, params))
+
+    def maybe_save_async(self, round_idx: int, params: Any) -> bool:
+        """``save_async`` on the every-K cadence; True if a save was queued."""
+        if round_idx % self.every == 0:
+            self.save_async(round_idx, params)
+            return True
+        return False
+
+    def wait(
+        self, raise_errors: bool = True, timeout: float | None = None
+    ) -> BaseException | None:
+        """Block until all queued async writes hit disk; re-raise the
+        first writer error (unless ``raise_errors=False`` — the
+        exception-unwind path, where a new raise would mask the
+        original; the suppressed error is RETURNED and recorded on the
+        ``checkpoint.async_write_error_suppressed`` obs counter so a
+        failed mid-run write cannot vanish without trace).
+
+        ``timeout`` (seconds) bounds the drain — the crash-unwind path
+        passes one so a write stalled on a hung filesystem cannot turn a
+        crash (or Ctrl-C) into a frozen process; on expiry a warning is
+        emitted and the daemon writer is left running instead of joined.
+
+        Also RETIRES the writer thread (shutdown sentinel + join) — a
+        Checkpointer left behind after its run leaks nothing; the next
+        ``save_async`` respawns the writer lazily.
+        """
+        if self._queue is not None:
+            if timeout is None:
+                self._queue.join()
+            else:
+                import time as time_mod
+
+                # Queue.join has no timeout; poll unfinished_tasks (a
+                # stable CPython attribute) against a deadline. A
+                # KeyboardInterrupt during the sleep propagates — wanted.
+                deadline = time_mod.monotonic() + timeout
+                while (
+                    self._queue.unfinished_tasks
+                    and time_mod.monotonic() < deadline
+                ):
+                    time_mod.sleep(0.05)
+                if self._queue.unfinished_tasks:
+                    import warnings
+
+                    warnings.warn(
+                        f"async checkpoint writer still busy after "
+                        f"{timeout:.1f}s; leaving the daemon writer "
+                        "behind — the latest on-disk checkpoint may be "
+                        "stale",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+                    if raise_errors:
+                        self._raise_pending()
+                        return None
+                    return self._pop_suppressed()
+            self._queue.put(None)
+            self._thread.join()
+            self._queue = None
+            self._thread = None
+        if raise_errors:
+            self._raise_pending()
+            return None
+        return self._pop_suppressed()
+
+    def _pop_suppressed(self) -> Exception | None:
+        err, self._error = self._error, None
+        if err is not None:
+            import warnings
+
+            from qfedx_tpu import obs
+
+            obs.counter("checkpoint.async_write_error_suppressed")
+            # The counter is QFEDX_TRACE-gated; the warning is NOT — in
+            # the default (untraced) config this is the guaranteed
+            # signal that the on-disk checkpoint may predate the crash.
+            warnings.warn(
+                "async checkpoint write failed and was suppressed during "
+                f"unwind: {err!r} — the latest on-disk checkpoint may "
+                "predate the crash round",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+        return err
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
 
     def _gc(self) -> None:
         if self.keep <= 0:

@@ -104,6 +104,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "--checkpoint-every still bounds a chunk. Default "
                         "10 (1 for --sv-size > 1, whose eval is host-side "
                         "and still paces chunks via --eval-every)")
+    t.add_argument("--pipeline-depth", type=int, default=None,
+                   help="software-pipeline depth of the round loop: issue "
+                        "chunk k+1 before draining chunk k's stats so host "
+                        "work (metrics/epsilon/JSONL/checkpoint) overlaps "
+                        "device compute. 0 = sequential dispatch-drain loop; "
+                        "default resolves QFEDX_PIPELINE, then 1. Training "
+                        "is bit-identical at any depth")
     t.add_argument("--eval-batches", type=int, default=None,
                    help="cap per-round eval at this many 256-sample batches")
     t.add_argument("--checkpoint-every", type=int, default=10)
@@ -195,6 +202,7 @@ def config_from_args(a: argparse.Namespace) -> ExperimentConfig:
             if a.rounds_per_call is not None
             else (1 if a.sv_size > 1 else 10)
         ),
+        pipeline_depth=a.pipeline_depth,
         eval_batches=a.eval_batches,
         checkpoint_every=a.checkpoint_every,
         seed=a.seed,
@@ -274,6 +282,7 @@ def run_train(
                 eval_every=cfg.eval_every,
                 eval_batches=cfg.eval_batches,
                 rounds_per_call=cfg.rounds_per_call,
+                pipeline_depth=cfg.pipeline_depth,
                 on_round_end=lambda r, m: (
                     run.on_round_end(r, m),
                     say(f"[round {r + 1:3d}] " + json.dumps(m)) if (r + 1) % 5 == 0 else None,
@@ -323,6 +332,18 @@ def main(argv=None):
     # jnp constants at import time). Nothing platform-related can be done
     # this late.
     args = build_parser().parse_args(argv)
+    # Persistent XLA compilation cache (QFEDX_COMPILE_CACHE; default on —
+    # shared definition with bench.py in qfedx_tpu.utils.cache). Enabled
+    # before dispatching ANY subcommand: train pays one cold n=18 slab
+    # compile (~50 s on-chip), sweep pays one per distinct cell shape ×
+    # seed — the heaviest CLI path benefits most. Must run before the
+    # first compile.
+    from qfedx_tpu.utils.cache import enable_compile_cache
+    from qfedx_tpu.utils.host import is_primary
+
+    cache_dir = enable_compile_cache()
+    if cache_dir and is_primary():
+        print(f"[qfedx_tpu] compile cache: {cache_dir}")
     if args.cmd == "train":
         cfg = config_from_args(args)
         run_train(cfg, resume=args.resume, plots=args.plots,

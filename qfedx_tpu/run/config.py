@@ -85,6 +85,13 @@ class ExperimentConfig:
     # depth), so the default scans deep out of the box; checkpoints still
     # bound a chunk.
     rounds_per_call: int = 10
+    # Software-pipeline depth of the trainer's round loop
+    # (run.trainer.resolve_pipeline_depth): chunk k+1 is dispatched
+    # before chunk k's stats are drained, so metrics/ε/JSONL/checkpoint
+    # host work overlaps device compute. None = QFEDX_PIPELINE, then 1
+    # (double-buffering); 0 = the sequential dispatch→drain loop.
+    # Bit-identical training at any depth.
+    pipeline_depth: int | None = None
     eval_batches: int | None = None  # cap eval cost on large eval sets
     checkpoint_every: int = 10
     seed: int = 42

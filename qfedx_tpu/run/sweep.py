@@ -283,6 +283,7 @@ def _run_cell(cell: dict, seed: int) -> dict:
         seed=seed,
         eval_every=cfg.eval_every,
         rounds_per_call=cfg.rounds_per_call,
+        pipeline_depth=cfg.pipeline_depth,
     )
     wall = time.perf_counter() - t0
     final = res.evaluate(res.params, test_x, test_y)

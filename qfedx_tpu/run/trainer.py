@@ -12,11 +12,14 @@ checkpoint-every-K-rounds with resume (ROADMAP.md:90-91), and JSONL metrics
 
 from __future__ import annotations
 
+import os
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from qfedx_tpu import obs
@@ -25,12 +28,13 @@ from qfedx_tpu.fed.config import FedConfig
 from qfedx_tpu.fed.evaluate import make_evaluator
 from qfedx_tpu.fed.round import (
     client_mesh,
+    donate_enabled,
     make_fed_round,
     make_fed_rounds,
     shard_client_data,
 )
 from qfedx_tpu.models.api import Model
-from qfedx_tpu.utils import trees
+from qfedx_tpu.utils import pins, trees
 
 
 @dataclass
@@ -53,6 +57,41 @@ class TrainResult:
         return self.accuracies[-1] if self.accuracies else 0.0
 
 
+def resolve_pipeline_depth(pipeline_depth: int | None = None) -> int:
+    """Software-pipeline depth of the trainer's round loop.
+
+    Depth D = how many dispatched-but-undrained chunks may be in flight:
+    0 reproduces the sequential dispatch→drain loop exactly; 1 (the
+    default) double-buffers — chunk k+1 is issued before chunk k's
+    stats are fetched, so metrics/accounting/JSONL/checkpoint host work
+    overlaps device compute. Training results are bit-identical at any
+    depth (same programs, same keys — pinned in tests/test_pipeline.py);
+    only the dispatch/drain interleaving changes.
+
+    An explicit ``pipeline_depth`` wins; otherwise the ``QFEDX_PIPELINE``
+    pin decides ('0'/'off' → 0, '1'/'on' → 1, or an integer depth).
+    Like QFEDX_TRACE this is a host-side loop knob, not trace-time
+    routing — but the trainer reads it once per ``train_federated`` call.
+    """
+    if pipeline_depth is not None:
+        depth = int(pipeline_depth)
+        if depth < 0:
+            raise ValueError(f"pipeline_depth must be >= 0, got {depth}")
+        return depth
+    env = os.environ.get("QFEDX_PIPELINE")
+    if env is None:
+        return 1
+    as_bool = pins.parse_onoff(env)
+    if as_bool is not None:
+        return 1 if as_bool else 0
+    if env.isdigit():
+        return int(env)
+    raise ValueError(
+        f"QFEDX_PIPELINE={env!r}: expected '0'/'off', '1'/'on' or an "
+        "integer depth"
+    )
+
+
 def train_federated(
     model: Model,
     cfg: FedConfig,
@@ -69,6 +108,7 @@ def train_federated(
     on_round_end: Callable[[int, dict], None] | None = None,
     checkpointer=None,
     rounds_per_call: int = 1,
+    pipeline_depth: int | None = None,
 ) -> TrainResult:
     """Run federated training; returns params + metric history.
 
@@ -83,6 +123,16 @@ def train_federated(
     cadence-K run should pick rounds_per_call dividing eval_every and the
     checkpoint interval for full effect. Per-round wall-clock inside a
     chunk is reported as chunk_time/chunk_len.
+    ``pipeline_depth``: software-pipeline depth of the round loop (see
+    ``resolve_pipeline_depth``; default: QFEDX_PIPELINE, then 1). At
+    depth ≥ 1 the loop issues chunk k+1 (its params input is chunk k's
+    device output — no host round-trip) BEFORE draining chunk k's
+    stats/accuracies with one batched fetch, so all per-round host work
+    (metrics, ε accounting, JSONL, checkpoint enqueue) overlaps device
+    compute; mid-run checkpoints go through the background writer
+    (``Checkpointer.save_async``) and the final-round save stays
+    synchronous. Depth 0 reproduces the sequential loop. Results are
+    bit-identical at any depth.
     """
     num_clients = cx.shape[0]
     if mesh is None:
@@ -114,7 +164,14 @@ def train_federated(
             while num_clients % n_dev != 0:
                 n_dev -= 1
             mesh = client_mesh(num_devices=n_dev)
-    round_fn = make_fed_round(model, cfg, mesh, num_clients=num_clients)
+    # Donation is opt-in at the fed.round boundary (direct callers reuse
+    # params buffers); the trainer qualifies — θ always chains through
+    # dispatch outputs, and the pipelined loop snapshots a device-side
+    # copy whenever a drain still needs θ past a donating dispatch.
+    donating = donate_enabled()
+    round_fn = make_fed_round(
+        model, cfg, mesh, num_clients=num_clients, donate=donating
+    )
     # Scanned chunks carry their own ON-DEVICE eval (fed.round
     # make_fed_rounds with_eval) for host-callable models, so eval_every
     # no longer caps the scan depth — per-round accuracy comes out of the
@@ -161,6 +218,7 @@ def train_federated(
             _chunk_fns[k] = make_fed_rounds(
                 model, cfg, mesh, num_clients=num_clients,
                 rounds_per_call=k, with_eval=in_scan_eval,
+                donate=donating,
             )
         return _chunk_fns[k]
     # Two evaluators: the capped one paces per-round eval (eval_batches
@@ -254,10 +312,12 @@ def train_federated(
             sigma=cfg.dp.noise_multiplier,
             num_steps=start_round * acct_steps,
         )
-    n_params = trees.tree_size(params)
     # Per round: each participating client uploads Δθ and downloads θ
-    # (ROADMAP.md:115's MB/round, exact in SPMD: one psum of |θ| floats).
-    comm_mb = 2 * n_params * 4 / 1e6
+    # (ROADMAP.md:115's MB/round, exact in SPMD: one psum of |θ| values).
+    # Sized from the ACTUAL leaf dtypes (trees.tree_bytes), not an
+    # assumed 4 bytes/param — a run whose params carry bf16/f16 leaves
+    # would otherwise over-report its wire volume 2×.
+    comm_mb = 2 * trees.tree_bytes(params) / 1e6
 
     result = TrainResult(
         params=params,
@@ -274,59 +334,56 @@ def train_federated(
             metrics0 = evaluate(params, test_x, test_y)
         result.accuracies.append(metrics0["accuracy"])
 
-    rnd = start_round
-    while rnd < num_rounds:
-        # Chunk length: never cross an eval or checkpoint boundary (host
-        # actions happen between dispatches), never past the end. With
-        # in-scan eval the accuracy comes out of the dispatch itself, so
-        # eval_every does not bound the chunk.
-        until_eval = (
-            num_rounds if in_scan_eval else eval_every - (rnd % eval_every)
-        )
-        until_ckpt = (
-            checkpointer.every - (rnd % checkpointer.every)
-            if checkpointer is not None
-            else rounds_per_call
-        )
-        chunk = min(rounds_per_call, until_eval, until_ckpt, num_rounds - rnd)
+    # --- the software-pipelined round loop (r09 tentpole) -------------------
+    # Depth D chunks may be dispatched-but-undrained at once: the params
+    # output of chunk k feeds chunk k+1 WITHOUT a host round-trip (JAX's
+    # async dispatch queues it behind the running program), and only then
+    # is chunk k's stats/accuracy tree drained with ONE batched fetch —
+    # so the device never idles while the host does metrics/ε/JSONL/
+    # checkpoint work. Depth 0 reproduces the sequential loop (drain
+    # immediately after dispatch). Results are bit-identical at any
+    # depth; only the interleaving changes (tests/test_pipeline.py).
+    depth = resolve_pipeline_depth(pipeline_depth)
+    # ``donating`` (read once, above, when the round fns were built):
+    # when they donate θ, a buffer the drain still needs (host eval /
+    # checkpoint) must be snapshot before the next dispatch consumes it.
 
-        t0 = time.perf_counter()
-        scan_accs = None
-        # The dispatch span covers trace+compile+execute of the chunk's
-        # device program; a cold compile inside it is ATTRIBUTED here via
-        # the jax.monitoring listener (Span.compile_s) instead of
-        # silently inflating round 1 (the r05 forensic case, PERF.md §11).
+    # In-flight chunks: (chunk_len, first_round, params_ref, stats, accs,
+    # dispatch_span, t_dispatch). params_ref is None unless this chunk's
+    # drain needs θ on host (eval off the scan path, checkpoint boundary,
+    # final round).
+    pending: deque = deque()
+    prev_fetch_end = 0.0
+
+    def drain_one() -> None:
+        nonlocal prev_fetch_end
+        (chunk, base_rnd, params_ref, stats, accs, sp_dispatch,
+         t_dispatch) = pending.popleft()
+        # ONE batched fetch for the whole chunk — replaces the pre-r09
+        # per-round float(stats.mean_loss) syncs and the
+        # block_until_ready barrier. This is the only point the hot loop
+        # blocks on the device.
         with obs.span(
-            "round.dispatch", round=rnd + 1, chunk=chunk
-        ) as sp_dispatch:
-            if chunk > 1 and rounds_per_call > 1:
-                chunk_fn = get_chunk_fn(chunk)
-                if in_scan_eval:
-                    params, (stats, accs) = chunk_fn(
-                        params, scx, scy, scm, round_key_base, rnd,
-                        ex_dev, ey_dev,
-                    )
-                    jax.block_until_ready(params)
-                    scan_accs = [float(a) for a in np.asarray(accs)]
-                else:
-                    params, stats = chunk_fn(
-                        params, scx, scy, scm, round_key_base, rnd
-                    )
-                    jax.block_until_ready(params)
-                losses = [float(l) for l in np.asarray(stats.mean_loss)]
-            else:
-                losses = []
-                for i in range(chunk):
-                    round_key = jax.random.fold_in(round_key_base, rnd + i)
-                    params, stats = round_fn(
-                        params, scx, scy, scm, round_key
-                    )
-                    losses.append(float(stats.mean_loss))
-                jax.block_until_ready(params)
-        dt_per_round = (time.perf_counter() - t0) / chunk
+            "round.fetch", round=base_rnd + 1, chunk=chunk
+        ) as sp_fetch:
+            stats_h, accs_h = jax.device_get((stats, accs))
+        t_fetch_end = time.perf_counter()
+        losses = [float(l) for l in np.ravel(np.asarray(stats_h.mean_loss))]
+        scan_accs = (
+            None
+            if accs_h is None
+            else [float(a) for a in np.ravel(np.asarray(accs_h))]
+        )
+        # Per-round wall: the drain-to-drain increment this chunk added.
+        # At depth 0 (prev drain precedes this dispatch) this is exactly
+        # the pre-r09 dispatch→ready window; pipelined, it is the
+        # steady-state cost per chunk WITH the overlap credited, which
+        # is what client-rounds/s should score.
+        dt_per_round = (t_fetch_end - max(t_dispatch, prev_fetch_end)) / chunk
+        prev_fetch_end = t_fetch_end
 
         for i in range(chunk):
-            r = rnd + i
+            r = base_rnd + i
             result.round_times_s.append(dt_per_round)
             result.losses.append(losses[i])
             metrics = {
@@ -370,26 +427,56 @@ def train_federated(
                 metrics["accuracy"] = scan_accs[i]
                 metrics["eval_n"] = int(ex_dev.shape[0])
             elif (r + 1) % eval_every == 0 or r == num_rounds - 1:
+                # Dispatch-side will_host_eval must have kept θ for this
+                # drain; a None here means the two predicates drifted.
+                assert params_ref is not None, (
+                    f"host eval at round {r + 1} but dispatch predicted "
+                    "no θ needed (will_host_eval drifted from the drain "
+                    "trigger)"
+                )
                 with obs.span("round.eval", round=r + 1) as sp_eval:
-                    eval_metrics = evaluate(params, test_x, test_y)
+                    eval_metrics = evaluate(params_ref, test_x, test_y)
                 result.accuracies.append(eval_metrics["accuracy"])
                 metrics.update(eval_metrics)
             if checkpointer is not None:
                 # Always persist the final round — the weights
                 # final_accuracy is reported for must exist on disk even
-                # off the every-K cadence.
+                # off the every-K cadence, and SYNCHRONOUSLY: queued
+                # async writes are drained first (ordering + error
+                # surfacing), then the final save lands before
+                # train_federated returns.
+                # Same drift guard as host eval: when this round actually
+                # saves, dispatch-side ckpt_due must have kept θ.
+                assert params_ref is not None or not (
+                    r == num_rounds - 1 or (r + 1) % checkpointer.every == 0
+                ), (
+                    f"checkpoint due at round {r + 1} but dispatch "
+                    "predicted no θ needed (ckpt_due drifted from the "
+                    "drain trigger)"
+                )
                 with obs.span("round.checkpoint", round=r + 1) as sp_ckpt:
                     if r == num_rounds - 1:
-                        checkpointer.save(r + 1, params)
+                        checkpointer.wait()
+                        checkpointer.save(r + 1, params_ref)
+                    elif depth > 0:
+                        # Background writer: the device→host snapshot +
+                        # atomic tmp/rename happen off the round loop,
+                        # so a checkpoint boundary no longer drains the
+                        # pipeline (run/checkpoint.py).
+                        checkpointer.maybe_save_async(r + 1, params_ref)
                     else:
-                        checkpointer.maybe_save(r + 1, params)
+                        checkpointer.maybe_save(r + 1, params_ref)
             if obs.enabled():
                 # Merge the round's phase walls into its metrics.jsonl
-                # row. dispatch/compile are per-chunk walls amortized to
-                # per-round shares (the scanned dispatch has no per-round
-                # boundary — same convention as time_s/chunk_rounds).
+                # row. dispatch/fetch/compile are per-chunk walls
+                # amortized to per-round shares (the scanned dispatch has
+                # no per-round boundary — same convention as
+                # time_s/chunk_rounds). dispatch_s is ENQUEUE wall
+                # (trace+compile+queue); the device-completion wait shows
+                # up in fetch_s.
                 phases = {
-                    "dispatch_s": round(sp_dispatch.duration / chunk, 6)
+                    "dispatch_s": round(sp_dispatch.duration / chunk, 6),
+                    "fetch_s": round(sp_fetch.duration / chunk, 6),
                 }
                 if sp_dispatch.compile_s > 0:
                     phases["compile_s"] = round(
@@ -405,7 +492,127 @@ def train_federated(
                     metrics["mem_bytes_in_use"] = mem["bytes_in_use"]
             if on_round_end is not None:
                 on_round_end(r, metrics)
-        rnd += chunk
+
+    rnd = start_round
+    try:
+        while rnd < num_rounds:
+            # Chunk length: never cross an eval or checkpoint boundary
+            # (host actions happen between dispatches), never past the
+            # end. With in-scan eval the accuracy comes out of the
+            # dispatch itself, so eval_every does not bound the chunk.
+            until_eval = (
+                num_rounds if in_scan_eval else eval_every - (rnd % eval_every)
+            )
+            until_ckpt = (
+                checkpointer.every - (rnd % checkpointer.every)
+                if checkpointer is not None
+                else rounds_per_call
+            )
+            chunk = min(
+                rounds_per_call, until_eval, until_ckpt, num_rounds - rnd
+            )
+
+            t_dispatch = time.perf_counter()
+            # The dispatch span covers trace+compile+ENQUEUE of the
+            # chunk's device program (execution wait lands in
+            # round.fetch); a cold compile inside it is ATTRIBUTED here
+            # via the jax.monitoring listener (Span.compile_s) instead of
+            # silently inflating round 1 (the r05 forensic case,
+            # PERF.md §11).
+            with obs.span(
+                "round.dispatch", round=rnd + 1, chunk=chunk
+            ) as sp_dispatch:
+                if chunk > 1 and rounds_per_call > 1:
+                    chunk_fn = get_chunk_fn(chunk)
+                    if in_scan_eval:
+                        params, (stats, accs) = chunk_fn(
+                            params, scx, scy, scm, round_key_base, rnd,
+                            ex_dev, ey_dev,
+                        )
+                    else:
+                        params, stats = chunk_fn(
+                            params, scx, scy, scm, round_key_base, rnd
+                        )
+                        accs = None
+                else:
+                    round_key = jax.random.fold_in(round_key_base, rnd)
+                    params, stats = round_fn(
+                        params, scx, scy, scm, round_key
+                    )
+                    accs = None
+
+            is_last = rnd + chunk >= num_rounds
+            will_host_eval = accs is None and (
+                (rnd + chunk) % eval_every == 0 or is_last
+            )
+            ckpt_due = checkpointer is not None and (
+                is_last or (rnd + chunk) % checkpointer.every == 0
+            )
+            params_ref = (
+                params if (is_last or will_host_eval or ckpt_due) else None
+            )
+            if (
+                params_ref is not None
+                and donating
+                and depth > 0
+                and not is_last
+            ):
+                # The NEXT dispatch will donate (consume) θ's buffer
+                # before this chunk's drain reads it — snapshot a
+                # device-side copy now. The copy op is queued on the
+                # in-order stream ahead of the donating dispatch, so it
+                # reads the live buffer; θ is KBs, the copy is noise.
+                params_ref = jax.tree.map(jnp.copy, params)
+            pending.append(
+                (chunk, rnd, params_ref, stats, accs, sp_dispatch,
+                 t_dispatch)
+            )
+            while len(pending) > depth:
+                drain_one()
+            rnd += chunk
+        while pending:
+            drain_one()
+    except BaseException as crash:
+        # A crash mid-loop (including an on_round_end hook raising, the
+        # fault-injection tests' shape) must not leave a queued async
+        # checkpoint half-flushed: drain the writer WITHOUT raising — the
+        # original exception propagates unmasked, and a checkpoint the
+        # crash round already enqueued is durable for the resume IF its
+        # write succeeded. A failed write must not vanish either: wait()
+        # returns the suppressed writer error (and bumps the
+        # checkpoint.async_write_error_suppressed counter); attach it as
+        # a note on the propagating exception where this Python has
+        # add_note (3.11+).
+        if checkpointer is not None:
+            try:
+                # Bounded: a write stalled on a hung filesystem must not
+                # turn the crash into a frozen, un-interruptible process.
+                werr = checkpointer.wait(raise_errors=False, timeout=60.0)
+            except Exception:  # noqa: BLE001 — unwind path stays silent
+                werr = None
+            if werr is not None:
+                if hasattr(crash, "add_note"):  # 3.11+
+                    crash.add_note(
+                        f"async checkpoint write also failed: {werr!r} — "
+                        "the latest on-disk checkpoint may predate the "
+                        "crash round"
+                    )
+                else:
+                    # 3.10: no add_note — chain the writer error onto the
+                    # END of the propagating exception's context chain so
+                    # it still renders ("During handling of the above
+                    # exception…") whatever context the crash already
+                    # carries. (wait() has also warned unconditionally.)
+                    tail, seen = crash, {id(crash)}
+                    while (
+                        tail.__context__ is not None
+                        and id(tail.__context__) not in seen
+                    ):
+                        tail = tail.__context__
+                        seen.add(id(tail))
+                    if tail.__context__ is None:
+                        tail.__context__ = werr
+        raise
 
     result.params = params
     # The in-scan eval set may be capped (2048 default / eval_batches) —

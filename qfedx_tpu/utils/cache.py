@@ -1,0 +1,70 @@
+"""Persistent XLA compilation cache — the QFEDX_COMPILE_CACHE pin.
+
+The big slab/fed programs take minutes to compile cold (~50 s for the
+n=18 engine step on the bench chip). bench.py has always pointed JAX's
+persistent compilation cache at a repo-local directory so every run
+after the first starts hot — but CLI users paid the full cold compile
+every process. This module is the ONE definition both entry points use:
+``benchmarks/_util.enable_cache`` delegates here with its repo-local
+default directory, and ``run/cli.py`` calls ``enable_compile_cache``
+before the first compile of a training run.
+
+``QFEDX_COMPILE_CACHE`` (read when the cache is enabled, i.e. before
+the first compile — it configures process-global jax state, not traced
+program structure):
+
+- ``0`` / ``off`` — disabled (every compile is cold);
+- unset / ``1`` / ``on`` — enabled at the caller's default directory
+  (the CLI uses ``~/.cache/qfedx_tpu/xla``; bench keeps the repo-local
+  ``.jax_cache`` its committed artifacts were produced with);
+- a path (contains a separator, or starts with ``~``/``.``) — enable
+  AND redirect there, e.g. to pod-shared storage;
+- anything else raises — the loud-typo convention every QFEDX_* pin
+  follows (a typoed off value must not silently measure the cached
+  path).
+"""
+
+from __future__ import annotations
+
+import os
+
+from qfedx_tpu.utils import pins
+
+_DEFAULT_DIR = os.path.join("~", ".cache", "qfedx_tpu", "xla")
+
+
+def compile_cache_dir(default: str | None = None) -> str | None:
+    """Resolve the cache directory from QFEDX_COMPILE_CACHE (see module
+    docstring); ``None`` means the cache is pinned off."""
+    env = os.environ.get("QFEDX_COMPILE_CACHE")
+    if env is None:
+        return os.path.expanduser(default or _DEFAULT_DIR)
+    as_bool = pins.parse_onoff(env)
+    if as_bool is False:
+        return None
+    if as_bool is True:
+        return os.path.expanduser(default or _DEFAULT_DIR)
+    if os.sep in env or env.startswith(("~", ".")):
+        return os.path.expanduser(env)
+    raise ValueError(
+        f"QFEDX_COMPILE_CACHE={env!r}: expected '0'/'off', '1'/'on' or a "
+        "directory path (with a path separator or ~/. prefix)"
+    )
+
+
+def enable_compile_cache(jax=None, default_dir: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at the resolved
+    directory. Returns the directory in effect, or None when pinned off
+    (or when this jax predates the cache config — the cache is an
+    optimization, never a hard dependency)."""
+    path = compile_cache_dir(default_dir)
+    if path is None:
+        return None
+    if jax is None:
+        import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        return None
+    return path

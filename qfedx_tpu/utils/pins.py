@@ -1,0 +1,48 @@
+"""The QFEDX_* boolean pin grammar — ONE parser for every on/off pin.
+
+Every boolean pin (QFEDX_FUSE, QFEDX_TRACE, QFEDX_DONATE, …) accepts
+``0``/``off``/``1``/``on`` case-insensitively and rejects anything else
+with a loud ValueError — a typo must never silently measure the other
+route (the wrong-path-measured error class, ADVICE r04 item 1). This
+module exists so the grammar is defined and tested once instead of
+hand-rolled per pin (by r09 five copies had grown, and they had already
+drifted on case handling).
+
+Import-light on purpose (os only): obs/trace.py calls this per span.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+
+def parse_onoff(value: str) -> bool | None:
+    """The grammar core: ``0``/``off`` → False, ``1``/``on`` → True
+    (case-insensitive), anything else → None. Extended pins
+    (QFEDX_PIPELINE's integer depths, QFEDX_COMPILE_CACHE's directory
+    values) parse their bool prefix through THIS so the on/off spelling
+    cannot drift per pin, then handle the None themselves."""
+    low = value.lower()
+    if low in ("0", "off"):
+        return False
+    if low in ("1", "on"):
+        return True
+    return None
+
+
+def bool_pin(name: str, default: bool | Callable[[], bool]) -> bool:
+    """Resolve the env pin ``name`` to a bool.
+
+    ``default`` applies when the variable is unset; pass a callable for
+    defaults that must stay lazy (e.g. ones that touch
+    ``jax.default_backend()`` — the backend is only consulted when the
+    pin does not decide).
+    """
+    env = os.environ.get(name)
+    if env is None:
+        return default() if callable(default) else default
+    val = parse_onoff(env)
+    if val is None:
+        raise ValueError(f"{name}={env!r}: expected '1'/'on' or '0'/'off'")
+    return val

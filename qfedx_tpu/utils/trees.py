@@ -56,6 +56,13 @@ def tree_size(tree: Pytree) -> int:
     return sum(int(x.size) for x in jax.tree.leaves(tree))
 
 
+def tree_bytes(tree: Pytree) -> int:
+    """Total parameter bytes — the exact per-direction wire volume of a
+    replicated-θ federated round, honest to each leaf's ACTUAL dtype (a
+    bf16 or int leaf counts its real width, not an assumed 4 bytes)."""
+    return sum(int(x.nbytes) for x in jax.tree.leaves(tree))
+
+
 def ravel(tree: Pytree):
     """Flatten a pytree to a single 1-D vector plus an unravel function."""
     return jax.flatten_util.ravel_pytree(tree)

@@ -227,6 +227,68 @@ def test_trainer_emits_phases_and_rollup(traced, tmp_path):
     _validate_chrome_trace(path)
 
 
+def test_pipelined_trace_schema_dispatch_overlaps_drain(traced, tmp_path):
+    """--trace on a pipelined run (r09): the exported trace.json carries
+    the round.dispatch / round.fetch span pair with their round/chunk
+    schema, and shows chunk k+1's dispatch event BEFORE chunk k's
+    host-side drain (round.fetch) — the overlap the pipeline exists
+    for, pinned on the artifact a human would actually load in
+    Perfetto. (The registry-level ordering contract, both depths, is
+    pinned in tests/test_pipeline.py.)"""
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+    from qfedx_tpu.run.trainer import train_federated
+
+    model = make_vqc_classifier(n_qubits=2, n_layers=1, num_classes=2)
+    rng = np.random.default_rng(1)
+    cx = rng.uniform(0, 1, (4, 8, 2)).astype(np.float32)
+    cy = rng.integers(0, 2, (4, 8)).astype(np.int32)
+    cm = np.ones((4, 8), dtype=np.float32)
+    tx = rng.uniform(0, 1, (16, 2)).astype(np.float32)
+    ty = rng.integers(0, 2, 16).astype(np.int32)
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1)
+
+    from qfedx_tpu.run.checkpoint import Checkpointer
+
+    rows = []
+    train_federated(
+        model, cfg, cx, cy, cm, tx, ty, num_rounds=4, rounds_per_call=2,
+        pipeline_depth=1, on_round_end=lambda r, m: rows.append(m),
+        checkpointer=Checkpointer(tmp_path / "ck", every=2),
+    )
+    path = obs.write_chrome_trace(tmp_path / "trace.json")
+    xs = _validate_chrome_trace(path)
+    events = json.loads(path.read_text())["traceEvents"]
+    # The async checkpoint write ran on the background writer thread —
+    # its track is NAMED in the trace, and the span is present.
+    assert any(s.name == "checkpoint.async_write"
+               for s in obs.registry().spans)
+    tnames = {
+        e["args"]["name"] for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    assert "qfedx-ckpt-writer" in tnames
+    disp = sorted(
+        (e for e in xs if e["name"] == "round.dispatch"),
+        key=lambda e: e["ts"],
+    )
+    fetch = sorted(
+        (e for e in xs if e["name"] == "round.fetch"), key=lambda e: e["ts"]
+    )
+    # Schema: both span families carry the chunk's first round + length.
+    assert [e["args"]["round"] for e in disp] == [1, 3]
+    assert [e["args"]["chunk"] for e in disp] == [2, 2]
+    assert [e["args"]["round"] for e in fetch] == [1, 3]
+    # The pipeline overlap, visible in the artifact: chunk 2's dispatch
+    # event starts before chunk 1's drain fetch does.
+    assert disp[1]["ts"] < fetch[0]["ts"]
+    # Every metrics row decomposes its wall into dispatch+fetch shares.
+    assert rows and all(
+        "dispatch_s" in r["phases"] and "fetch_s" in r["phases"]
+        for r in rows
+    )
+
+
 def test_fuse_counters_via_engine(traced, monkeypatch):
     """The fusion pass reports trace-time op counts when it runs."""
     import jax

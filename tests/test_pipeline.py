@@ -1,0 +1,268 @@
+"""Pipelined trainer ≡ sequential trainer (r09 tentpole).
+
+The round loop is a software pipeline: at depth D, chunk k+1 is
+dispatched (its params input is chunk k's device output — no host
+round-trip) before chunk k's stats are drained with one batched fetch,
+so host work overlaps device compute. The contract pinned here:
+
+- depth 0 vs depth ≥ 1 is BIT-IDENTICAL — losses, accuracies, ε series,
+  metrics.jsonl rows (modulo wall-clock fields), final params;
+- buffer donation (QFEDX_DONATE, fed.round) changes no results — pinned
+  the same way test_fold_clients pins the client fold;
+- mid-run crash + resume through the ASYNC checkpoint writer lands on
+  the uninterrupted trajectory;
+- the hot loop performs no blocking fetch between issuing chunk k+1 and
+  draining chunk k (instrumented via the obs registry: the k+1
+  round.dispatch span opens before chunk k's round.fetch span), and
+  depth 0 reproduces the sequential order.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from qfedx_tpu import obs
+from qfedx_tpu.fed.config import DPConfig, FedConfig
+from qfedx_tpu.models.vqc import make_vqc_classifier
+from qfedx_tpu.run.checkpoint import Checkpointer
+from qfedx_tpu.run.trainer import resolve_pipeline_depth, train_federated
+
+_TIME_KEYS = ("time_s", "phases", "mem_bytes_in_use")
+
+
+def _setup(seed=0, clients=4, samples=8, n_q=2):
+    model = make_vqc_classifier(n_qubits=n_q, n_layers=1, num_classes=2)
+    rng = np.random.default_rng(seed)
+    cx = rng.uniform(0, 1, (clients, samples, n_q)).astype(np.float32)
+    cy = rng.integers(0, 2, (clients, samples)).astype(np.int32)
+    cm = np.ones((clients, samples), dtype=np.float32)
+    tx = rng.uniform(0, 1, (16, n_q)).astype(np.float32)
+    ty = rng.integers(0, 2, 16).astype(np.int32)
+    return model, cx, cy, cm, tx, ty
+
+
+def _strip_time(row):
+    return {k: v for k, v in row.items() if k not in _TIME_KEYS}
+
+
+def test_resolve_pipeline_depth_pin(monkeypatch):
+    monkeypatch.delenv("QFEDX_PIPELINE", raising=False)
+    assert resolve_pipeline_depth() == 1  # default on, double-buffering
+    assert resolve_pipeline_depth(0) == 0  # explicit arg wins
+    monkeypatch.setenv("QFEDX_PIPELINE", "3")
+    assert resolve_pipeline_depth(0) == 0
+    for env, want in (
+        ("0", 0), ("off", 0), ("OFF", 0), ("1", 1), ("on", 1), ("ON", 1),
+        ("2", 2),
+    ):
+        monkeypatch.setenv("QFEDX_PIPELINE", env)
+        assert resolve_pipeline_depth() == want
+    monkeypatch.setenv("QFEDX_PIPELINE", "fast")
+    with pytest.raises(ValueError, match="QFEDX_PIPELINE"):
+        resolve_pipeline_depth()
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        resolve_pipeline_depth(-1)
+
+
+def test_donate_pin_grammar(monkeypatch):
+    """QFEDX_DONATE accepts the same 0/off/1/on grammar as its r09
+    sibling pins and raises loudly on typos."""
+    from qfedx_tpu.fed.round import donate_enabled
+
+    for env, want in (("0", False), ("off", False), ("OFF", False),
+                      ("1", True), ("on", True), ("ON", True)):
+        monkeypatch.setenv("QFEDX_DONATE", env)
+        assert donate_enabled() is want
+    monkeypatch.setenv("QFEDX_DONATE", "yes")
+    with pytest.raises(ValueError, match="QFEDX_DONATE"):
+        donate_enabled()
+    monkeypatch.delenv("QFEDX_DONATE")
+    assert donate_enabled() is (jax.default_backend() != "cpu")
+
+
+def test_depth_parity_scanned(tmp_path):
+    """Depth 0 ≡ 1 ≡ 2 on the scanned in-scan-eval path: losses,
+    accuracies, and the metrics.jsonl rows the run writes (wall-clock
+    fields excluded — they are the thing the pipeline changes)."""
+    from qfedx_tpu.run.metrics import ExperimentRun
+
+    model, cx, cy, cm, tx, ty = _setup()
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.1, optimizer="adam"
+    )
+    out = {}
+    for depth in (0, 1, 2):
+        with ExperimentRun(tmp_path, f"d{depth}", config=cfg) as run:
+            res = train_federated(
+                model, cx=cx, cy=cy, cmask=cm, test_x=tx, test_y=ty,
+                cfg=cfg, num_rounds=6, rounds_per_call=3, seed=7,
+                pipeline_depth=depth, on_round_end=run.on_round_end,
+            )
+        rows = [
+            json.loads(l)
+            for l in (run.dir / "metrics.jsonl").read_text().splitlines()
+        ]
+        for row in rows:
+            row.pop("ts", None)
+        out[depth] = (res, [_strip_time(r) for r in rows])
+    res0, rows0 = out[0]
+    for depth in (1, 2):
+        res_d, rows_d = out[depth]
+        assert res_d.losses == res0.losses
+        assert res_d.accuracies == res0.accuracies
+        assert rows_d == rows0
+        for a, b in zip(
+            jax.tree.leaves(res_d.params), jax.tree.leaves(res0.params)
+        ):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_depth_parity_dp_secure_agg():
+    """The full privacy composition (client-mode DP + ring secure-agg +
+    client sampling) drains through the pipeline unchanged: ε series and
+    params bit-equal at depth 0 vs 1."""
+    model, cx, cy, cm, tx, ty = _setup(seed=2)
+    cfg = FedConfig(
+        local_epochs=1,
+        batch_size=4,
+        learning_rate=0.1,
+        client_fraction=0.6,
+        dp=DPConfig(clip_norm=0.5, noise_multiplier=0.5),
+        secure_agg=True,
+    )
+    res = {
+        depth: train_federated(
+            model, cx=cx, cy=cy, cmask=cm, test_x=tx, test_y=ty, cfg=cfg,
+            num_rounds=4, rounds_per_call=2, seed=11, pipeline_depth=depth,
+        )
+        for depth in (0, 1)
+    }
+    assert res[1].losses == res[0].losses
+    assert res[1].epsilons == res[0].epsilons
+    assert res[1].accuracies == res[0].accuracies
+    for a, b in zip(
+        jax.tree.leaves(res[1].params), jax.tree.leaves(res[0].params)
+    ):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pipeline_crash_resume_matches_uninterrupted(tmp_path):
+    """Crash mid-run under the pipelined loop + async checkpoint writer,
+    then resume: the trainer must drain the queued round-3 write before
+    propagating (the checkpoint the resume needs is durable), and the
+    resumed trajectory lands bit-exactly on the uninterrupted depth-0
+    run (same fold-in key derivation at any depth)."""
+    model, cx, cy, cm, tx, ty = _setup(seed=3)
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.1, optimizer="adam"
+    )
+    ref = train_federated(
+        model, cx=cx, cy=cy, cmask=cm, test_x=tx, test_y=ty, cfg=cfg,
+        num_rounds=5, seed=11, pipeline_depth=0,
+        checkpointer=Checkpointer(tmp_path / "ref", every=1),
+    )
+
+    class Crash(RuntimeError):
+        pass
+
+    ck = Checkpointer(tmp_path / "crash", every=1)
+
+    def die_at_3(rnd, metrics):
+        if rnd + 1 == 3:
+            raise Crash()
+
+    with pytest.raises(Crash):
+        train_federated(
+            model, cx=cx, cy=cy, cmask=cm, test_x=tx, test_y=ty, cfg=cfg,
+            num_rounds=5, seed=11, pipeline_depth=1, checkpointer=ck,
+            on_round_end=die_at_3,
+        )
+    # The async write of round 3 was queued before the hook raised; the
+    # trainer's unwind path waits for it — durable before we get here.
+    assert ck.latest_round() == 3
+
+    res = train_federated(
+        model, cx=cx, cy=cy, cmask=cm, test_x=tx, test_y=ty, cfg=cfg,
+        num_rounds=5, seed=11, pipeline_depth=1, checkpointer=ck,
+    )
+    assert len(res.round_times_s) == 2  # only rounds 4-5 ran
+    for got, want in zip(
+        jax.tree.leaves(res.params), jax.tree.leaves(ref.params)
+    ):
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_donation_parity(tmp_path, monkeypatch):
+    """QFEDX_DONATE pinned 1 ≡ 0 (the fold-pin precedent): donation is a
+    buffer-aliasing decision, never a math decision — including through
+    a pipelined run with a mid-run checkpoint boundary, where the
+    trainer must snapshot θ before the donating next dispatch consumes
+    it."""
+    model, cx, cy, cm, tx, ty = _setup(seed=4)
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.1, optimizer="adam"
+    )
+    results = {}
+    for pin in ("1", "0"):
+        monkeypatch.setenv("QFEDX_DONATE", pin)
+        ck = Checkpointer(tmp_path / f"donate{pin}", every=2)
+        results[pin] = train_federated(
+            model, cx=cx, cy=cy, cmask=cm, test_x=tx, test_y=ty, cfg=cfg,
+            num_rounds=4, rounds_per_call=2, seed=5, pipeline_depth=1,
+            checkpointer=ck,
+        )
+        assert ck.latest_round() == 4
+    assert results["1"].losses == results["0"].losses
+    assert results["1"].accuracies == results["0"].accuracies
+    for a, b in zip(
+        jax.tree.leaves(results["1"].params),
+        jax.tree.leaves(results["0"].params),
+    ):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_no_blocking_fetch_between_dispatch_and_drain(monkeypatch):
+    """The pipeline contract, instrumented via the obs registry: at
+    depth 1 the round.dispatch span of chunk k+1 OPENS before the
+    round.fetch span of chunk k (no blocking fetch between issuing k+1
+    and draining k); at depth 0 chunk k is fully drained before chunk
+    k+1 is issued — the sequential loop, reproduced exactly."""
+    monkeypatch.setenv("QFEDX_TRACE", "1")
+    model, cx, cy, cm, tx, ty = _setup(seed=5)
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.1, optimizer="adam"
+    )
+
+    def spans_for(depth):
+        obs.reset()
+        train_federated(
+            model, cx=cx, cy=cy, cmask=cm, test_x=tx, test_y=ty, cfg=cfg,
+            num_rounds=6, rounds_per_call=3, seed=6, pipeline_depth=depth,
+        )
+        spans = obs.registry().spans
+        disp = sorted(
+            (s for s in spans if s.name == "round.dispatch"),
+            key=lambda s: s.t0,
+        )
+        fetch = sorted(
+            (s for s in spans if s.name == "round.fetch"), key=lambda s: s.t0
+        )
+        obs.reset()
+        return disp, fetch
+
+    disp, fetch = spans_for(depth=1)
+    # Two 3-round chunks; spans carry the schema (first round + length).
+    assert [s.meta["round"] for s in disp] == [1, 4]
+    assert [s.meta["chunk"] for s in disp] == [3, 3]
+    assert [s.meta["round"] for s in fetch] == [1, 4]
+    # Chunk 2 issued strictly before chunk 1's drain fetch begins.
+    assert disp[1].t0 < fetch[0].t0
+    # Fetches drain in chunk order.
+    assert fetch[0].t1 <= fetch[1].t0
+
+    disp, fetch = spans_for(depth=0)
+    assert [s.meta["round"] for s in disp] == [1, 4]
+    # Sequential: chunk 1 fully drained before chunk 2 is dispatched.
+    assert fetch[0].t1 <= disp[1].t0

@@ -94,6 +94,188 @@ def test_killed_writer_leaves_whole_json_lines(tmp_path):
     assert [json.loads(l)["round"] for l in lines] == [1, 2, 3]
 
 
+def test_async_checkpointer_roundtrip_and_cadence(tmp_path):
+    """save_async + wait ≡ save: same files, same restore; the async
+    cadence helper fires on the same every-K schedule as maybe_save."""
+    ck = Checkpointer(tmp_path, every=2, keep=3)
+    params = small_params()
+    queued = [r for r in range(1, 7) if ck.maybe_save_async(r, params)]
+    ck.wait()
+    assert queued == [2, 4, 6]
+    assert sorted(ck._rounds()) == [2, 4, 6]
+    restored, rnd = ck.restore_latest(jax.tree.map(jnp.zeros_like, params))
+    assert rnd == 6
+    for got, want in zip(jax.tree.leaves(restored), jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+def test_async_writer_error_surfaces_on_wait(tmp_path, monkeypatch):
+    """A writer-thread failure must not vanish: wait() re-raises it."""
+    ck = Checkpointer(tmp_path, every=1)
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(np, "savez", boom)
+    ck.save_async(1, small_params())
+    with pytest.raises(OSError, match="disk full"):
+        ck.wait()
+    # The error is consumed — the writer is reusable afterwards.
+    monkeypatch.undo()
+    ck.save_async(2, small_params())
+    ck.wait()
+    assert ck.latest_round() == 2
+
+
+def test_async_writer_error_suppressed_on_unwind_is_returned(
+    tmp_path, monkeypatch
+):
+    """wait(raise_errors=False) — the trainer's crash-unwind path — must
+    not silently erase a writer failure: the suppressed error is
+    returned (the trainer attaches it to the propagating exception)."""
+    ck = Checkpointer(tmp_path, every=1)
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(np, "savez", boom)
+    ck.save_async(1, small_params())
+    err = ck.wait(raise_errors=False)
+    assert isinstance(err, OSError)
+    assert ck.wait(raise_errors=False) is None  # consumed exactly once
+
+
+def test_crash_unwind_surfaces_pending_writer_error(tmp_path, monkeypatch):
+    """A failed async write followed by an unrelated crash: the writer
+    error must ride along on the propagating exception (add_note on
+    3.11+, __context__ chaining on 3.10) instead of vanishing — the
+    operator must learn the on-disk checkpoint predates the crash."""
+    model, cx, cy, cm, tx, ty = _toy_training_setup()
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1,
+                    optimizer="adam")
+    ck = Checkpointer(tmp_path, every=2)
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(np, "savez", boom)
+
+    class Crash(RuntimeError):
+        pass
+
+    def die_at_3(rnd, metrics):
+        if rnd + 1 == 3:  # after round 2's async write has failed
+            # Crash from inside an except block: the crash arrives with
+            # its __context__ already occupied — the writer error must
+            # still surface (appended to the END of the chain on 3.10).
+            try:
+                raise KeyError("inner")
+            except KeyError:
+                raise Crash()
+
+    with pytest.warns(RuntimeWarning, match="checkpoint"):
+        with pytest.raises(Crash) as ei:
+            train_federated(
+                model, cfg, cx, cy, cm, tx, ty, num_rounds=5,
+                pipeline_depth=1, checkpointer=ck, on_round_end=die_at_3,
+            )
+    exc = ei.value
+    notes = getattr(exc, "__notes__", [])
+    chain, seen = [], set()
+    while exc is not None and id(exc) not in seen:
+        chain.append(exc)
+        seen.add(id(exc))
+        exc = exc.__context__
+    assert any("checkpoint" in n for n in notes) or any(
+        isinstance(e, OSError) for e in chain
+    )
+
+
+def test_async_checkpoint_killed_mid_write_never_corrupts_latest(tmp_path):
+    """The async sibling of the killed-metrics-writer test: a checkpoint
+    write killed MID-FILE (partial tmp bytes, then os._exit — the
+    OOM-kill/SIGKILL shape, no atexit, no flush) must never leave a
+    corrupt latest checkpoint. Atomic tmp+rename guarantees the
+    interrupted round simply does not exist; the prior round restores."""
+    import subprocess
+    import sys
+
+    code = (
+        "import os\n"
+        "import numpy as np\n"
+        "from qfedx_tpu.run.checkpoint import Checkpointer\n"
+        "params = {'a': np.arange(6.0, dtype=np.float32).reshape(3, 2)}\n"
+        f"ck = Checkpointer({str(tmp_path)!r}, every=1)\n"
+        "ck.save(1, params)\n"
+        "def partial_then_die(f, *arrs):\n"
+        "    f.write(b'corrupt partial npz bytes')\n"
+        "    f.flush()\n"
+        "    os._exit(1)\n"
+        "np.savez = partial_then_die\n"
+        "ck.save_async(2, params)\n"
+        "ck.wait()\n"
+        "os._exit(0)\n"  # unreachable: the writer thread kills the process
+    )
+    proc = subprocess.run([sys.executable, "-c", code], timeout=240)
+    assert proc.returncode == 1
+    ck = Checkpointer(tmp_path, every=1)
+    assert ck.latest_round() == 1  # round 2 never became visible
+    assert not (tmp_path / "ckpt_000002.npz").exists()
+    template = {"a": jnp.zeros((3, 2))}
+    restored, rnd = ck.restore_latest(template)
+    assert rnd == 1
+    np.testing.assert_allclose(
+        np.asarray(restored["a"]),
+        np.arange(6.0, dtype=np.float32).reshape(3, 2),
+    )
+
+
+def test_compile_cache_pin_matrix(monkeypatch, tmp_path):
+    """QFEDX_COMPILE_CACHE resolution: off/on/path, loud on typos (the
+    QFEDX_* pin convention — a typoed off value must not silently
+    measure the cached path)."""
+    from qfedx_tpu.utils.cache import compile_cache_dir
+
+    monkeypatch.delenv("QFEDX_COMPILE_CACHE", raising=False)
+    default = str(tmp_path / "default")
+    assert compile_cache_dir(default) == default
+    for off in ("0", "off", "OFF"):
+        monkeypatch.setenv("QFEDX_COMPILE_CACHE", off)
+        assert compile_cache_dir(default) is None
+    for on in ("1", "on", "ON"):
+        monkeypatch.setenv("QFEDX_COMPILE_CACHE", on)
+        assert compile_cache_dir(default) == default
+    monkeypatch.setenv("QFEDX_COMPILE_CACHE", str(tmp_path / "redirect"))
+    assert compile_cache_dir(default) == str(tmp_path / "redirect")
+    monkeypatch.setenv("QFEDX_COMPILE_CACHE", "~/xla")
+    assert compile_cache_dir(default).endswith("/xla")
+    for typo in ("0ff", "false", "no", "xla_cache"):
+        monkeypatch.setenv("QFEDX_COMPILE_CACHE", typo)
+        with pytest.raises(ValueError, match="QFEDX_COMPILE_CACHE"):
+            compile_cache_dir(default)
+
+
+def test_trainer_async_final_round_durable(tmp_path):
+    """Pipelined trainer + async writer: the FINAL round's save is
+    synchronous by contract — after train_federated returns, the last
+    round is on disk (even off the every-K cadence) and restores to the
+    exact returned params."""
+    model, cx, cy, cm, tx, ty = _toy_training_setup()
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1,
+                    optimizer="adam")
+    ck = Checkpointer(tmp_path, every=2)
+    res = train_federated(
+        model, cfg, cx, cy, cm, tx, ty, num_rounds=3, pipeline_depth=1,
+        checkpointer=ck,
+    )
+    assert ck.latest_round() == 3  # 3 is off the every-2 cadence
+    restored, _ = ck.restore_latest(jax.tree.map(jnp.zeros_like, res.params))
+    for got, want in zip(
+        jax.tree.leaves(restored), jax.tree.leaves(res.params)
+    ):
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_agreed_run_dir_name_matrix(tmp_path):
     """Single-process resume/collide matrix of the run-dir naming rule
     (the multi-host broadcast path shares the collide semantics; its
