@@ -5,6 +5,11 @@ from qfedx_tpu.data.partition import (  # noqa: F401
     pack_clients,
 )
 from qfedx_tpu.data.pipeline import preprocess  # noqa: F401
+from qfedx_tpu.data.stream import (  # noqa: F401
+    ArrayRegistry,
+    SyntheticRegistry,
+    WaveStream,
+)
 from qfedx_tpu.data.viz import (  # noqa: F401
     save_class_distribution,
     save_client_samples,

@@ -1,0 +1,282 @@
+"""Streamed client ingestion: registry → per-round cohort → H2D waves.
+
+The r06–r09 fed path requires the WHOLE cohort's packed data resident in
+HBM before a round starts (``shard_client_data`` uploads [C, S, ...]
+once) — a hard ceiling of a few hundred clients per chip. This module
+breaks the ceiling on the host side of the r10 hierarchy: a round's
+cohort is sampled from a REGISTRY of potentially millions of clients
+(``fed.sampling.CohortSampler``), split into fixed-size waves, and each
+wave's client data is staged host→device by a background uploader
+(``WaveStream``) while the previous wave computes its
+``fed.round.RoundPartial`` — so a round processes W × C clients with
+only ``depth + 1`` waves ever resident in HBM.
+
+Two registry flavors, one duck-typed contract
+(``num_clients`` attribute + ``batch(ids) -> (cx, cy, cmask)``):
+
+- ``SyntheticRegistry`` — the simulated million-client registry: every
+  client's dataset is a pure counter-based hash of (seed, client id), so
+  ``batch`` materializes ONLY the requested ids (10⁶ clients cost zero
+  bytes until sampled) and a client's data is identical whenever and
+  wherever it is fetched — the property resume determinism rides on.
+- ``ArrayRegistry`` — wraps pre-packed ``pack_clients`` arrays, so the
+  streamed path can be parity-pinned against the resident flat path on
+  the SAME bytes (tests/test_stream.py).
+
+``QFEDX_STREAM`` pins the prefetch depth (read per ``WaveStream``, like
+QFEDX_PIPELINE): ``0``/``off`` → synchronous in-loop uploads (no
+thread), ``1``/``on`` (default) → double buffering — wave w+1 uploads
+while wave w computes — or a bare integer for deeper prefetch. Depth
+never changes results, only when H2D happens. Observability:
+``ingest.h2d`` spans (on the uploader thread — its own track in
+trace.json) and an ``ingest.queue_depth`` gauge.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from qfedx_tpu import obs
+from qfedx_tpu.utils import pins
+
+
+def resolve_stream_depth(depth: int | None = None) -> int:
+    """Prefetch depth of the wave uploader: how many uploaded-but-unread
+    waves may be staged ahead of compute. An explicit ``depth`` wins;
+    otherwise the ``QFEDX_STREAM`` pin ('0'/'off' → 0 = synchronous,
+    '1'/'on' → 1 = double buffering, or an integer depth), default 1."""
+    if depth is not None:
+        depth = int(depth)
+        if depth < 0:
+            raise ValueError(f"stream depth must be >= 0, got {depth}")
+        return depth
+    return pins.depth_pin("QFEDX_STREAM", 1)
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized SplitMix64 finalizer: uint64 → well-mixed uint64. The
+    counter-based PRG behind SyntheticRegistry — stateless, so client
+    data is a pure function of (seed, client, sample, feature)."""
+    with np.errstate(over="ignore"):  # mod-2^64 wraparound IS the mixer
+        x = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+        x ^= x >> np.uint64(30)
+        x *= np.uint64(0xBF58476D1CE4E5B9)
+        x ^= x >> np.uint64(27)
+        x *= np.uint64(0x94D049BB133111EB)
+        x ^= x >> np.uint64(31)
+    return x
+
+
+def _uniform01(bits: np.ndarray) -> np.ndarray:
+    """uint64 hash words → float32 uniforms in [0, 1)."""
+    return ((bits >> np.uint64(40)) / np.float32(1 << 24)).astype(np.float32)
+
+
+class SyntheticRegistry:
+    """A simulated registry of ``num_clients`` federated clients whose
+    data is generated on demand.
+
+    Each client owns ``samples`` feature vectors of width ``n_features``
+    in [0, 1) with the same learnable signal as the cohort tests
+    (label = mean feature > 0.5), derived counter-style from
+    (seed, client id, sample, feature) — no per-client state, no
+    materialized dataset, so ``num_clients`` can be 10⁶+ for free and
+    ``batch`` cost scales with the WAVE, not the registry.
+    """
+
+    def __init__(
+        self,
+        num_clients: int,
+        samples: int = 8,
+        n_features: int = 8,
+        seed: int = 0,
+    ):
+        if num_clients < 1:
+            raise ValueError("num_clients must be >= 1")
+        self.num_clients = int(num_clients)
+        self.samples = int(samples)
+        self.n_features = int(n_features)
+        self.seed = int(seed)
+
+    def batch(self, ids: np.ndarray):
+        """Materialize the clients ``ids`` as packed ``(cx, cy, cmask)``
+        arrays of shape [len(ids), samples, n_features] / [., samples]."""
+        ids = np.asarray(ids, dtype=np.uint64)
+        if ids.size and (int(ids.max()) >= self.num_clients):
+            raise ValueError("client id outside the registry")
+        s, f = self.samples, self.n_features
+        counters = (
+            (ids[:, None, None] * np.uint64(s)
+             + np.arange(s, dtype=np.uint64)[None, :, None]) * np.uint64(f)
+            + np.arange(f, dtype=np.uint64)[None, None, :]
+        )
+        cx = _uniform01(
+            _splitmix64(counters ^ _splitmix64(np.uint64(self.seed)))
+        )
+        cy = (cx.mean(axis=2) > 0.5).astype(np.int32)
+        cmask = np.ones((len(ids), s), dtype=np.float32)
+        return cx, cy, cmask
+
+
+class ArrayRegistry:
+    """Registry view over pre-packed client arrays (``pack_clients``
+    layout) — the parity bridge: the streamed path and the resident flat
+    path read the same bytes, so their results can be compared
+    client-for-client (tests/test_stream.py, tests/test_hier.py)."""
+
+    def __init__(self, cx: np.ndarray, cy: np.ndarray, cmask: np.ndarray):
+        if not (len(cx) == len(cy) == len(cmask)):
+            raise ValueError("cx/cy/cmask disagree on client count")
+        self.num_clients = len(cx)
+        self._cx, self._cy, self._cmask = cx, cy, cmask
+
+    def batch(self, ids: np.ndarray):
+        ids = np.asarray(ids, dtype=np.int64)
+        return self._cx[ids], self._cy[ids], self._cmask[ids]
+
+
+class WaveStream:
+    """Iterator of device-resident wave batches for ONE round.
+
+    ``for wave_base, (scx, scy, scm) in WaveStream(...)`` yields each
+    wave's packed client arrays already ``device_put`` with the client
+    dim sharded over ``axis``, in cohort order; ``wave_base`` is the
+    wave's offset into the round's cohort (the ``wave_base`` argument of
+    ``fed.round.make_fed_round_partial``). At depth ≥ 1 a daemon thread
+    runs ``registry.batch`` + ``jax.device_put`` up to ``depth`` waves
+    ahead, so wave w+1's H2D transfer overlaps wave w's compute —
+    ``ingest.h2d`` spans land on the uploader thread and an
+    ``ingest.queue_depth`` gauge tracks staging occupancy. Depth 0
+    uploads synchronously in the consumer loop (the sequential
+    reference). Uploader errors re-raise in the consumer at the wave
+    where they occurred; ``close()`` stops a partially consumed stream.
+    """
+
+    _DONE = object()
+
+    def __init__(
+        self,
+        registry,
+        mesh,
+        cohort_ids: np.ndarray,
+        wave_size: int,
+        depth: int | None = None,
+        axis: str = "clients",
+    ):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        cohort_ids = np.asarray(cohort_ids)
+        if wave_size < 1 or len(cohort_ids) % wave_size != 0:
+            raise ValueError(
+                f"cohort of {len(cohort_ids)} not divisible by "
+                f"wave_size={wave_size}"
+            )
+        if wave_size % mesh.shape[axis] != 0:
+            raise ValueError(
+                f"wave_size={wave_size} not divisible by mesh axis "
+                f"{axis}={mesh.shape[axis]}"
+            )
+        self._jax = jax
+        self._registry = registry
+        self._ids = cohort_ids
+        self._wave_size = int(wave_size)
+        self.num_waves = len(cohort_ids) // int(wave_size)
+        self._sharding = NamedSharding(mesh, P(axis))
+        self.depth = resolve_stream_depth(depth)
+        self._next_wave = 0
+        self._closed = False
+        self._queue: queue.Queue | None = None
+        self._thread: threading.Thread | None = None
+        if self.depth > 0 and self.num_waves > 1:
+            self._queue = queue.Queue(maxsize=self.depth)
+            self._thread = threading.Thread(
+                target=self._uploader, name="qfedx-ingest", daemon=True
+            )
+            self._thread.start()
+
+    def _upload(self, wave: int):
+        """Host batch → sharded device arrays for one wave. device_put is
+        asynchronous — the transfer is queued, not awaited, so compute on
+        in-flight waves and H2D genuinely overlap."""
+        lo = wave * self._wave_size
+        ids = self._ids[lo:lo + self._wave_size]
+        cx, cy, cmask = self._registry.batch(ids)
+        with obs.span("ingest.h2d", wave=wave, clients=len(ids)):
+            put = self._jax.device_put
+            out = (
+                put(np.ascontiguousarray(cx), self._sharding),
+                put(np.ascontiguousarray(cy), self._sharding),
+                put(np.asarray(cmask, dtype=np.float32), self._sharding),
+            )
+        return lo, out
+
+    def _put(self, item) -> bool:
+        """Queue an item without ever deadlocking against ``close()``:
+        block only while the stream is open (short timeout, re-checking
+        ``_closed``); once closed the consumer is gone, so drop the item
+        and let the thread exit instead of blocking on a full queue."""
+        while not self._closed:
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _uploader(self) -> None:
+        try:
+            for wave in range(self.num_waves):
+                if self._closed:
+                    break
+                item = self._upload(wave)
+                if not self._put(item):
+                    return
+                obs.gauge("ingest.queue_depth", self._queue.qsize())
+        except BaseException as exc:  # noqa: BLE001 — re-raised by consumer
+            self._put(exc)
+        else:
+            self._put(self._DONE)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._next_wave >= self.num_waves or self._closed:
+            raise StopIteration
+        if self._queue is None:
+            item = self._upload(self._next_wave)
+        else:
+            item = self._queue.get()
+            obs.gauge("ingest.queue_depth", self._queue.qsize())
+            if item is self._DONE:
+                raise StopIteration
+            if isinstance(item, BaseException):
+                self._closed = True
+                raise item
+        self._next_wave += 1
+        return item
+
+    def close(self) -> None:
+        """Stop the uploader and release staged waves (safe to call on a
+        fully consumed stream; the trainer calls it on every exit path)."""
+        self._closed = True
+        if self._queue is not None:
+
+            def drain():
+                try:
+                    while True:
+                        self._queue.get_nowait()
+                except queue.Empty:
+                    pass
+
+            # Unblock a put-blocked uploader (its _put re-checks _closed
+            # within its timeout), join, then drain once more to release
+            # any wave the thread staged between the two steps.
+            drain()
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+            drain()

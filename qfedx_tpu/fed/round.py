@@ -52,6 +52,39 @@ class RoundStats(NamedTuple):
     num_participants: jax.Array
 
 
+class RoundPartial(NamedTuple):
+    """Per-chip partial aggregate of one WAVE of clients (r10 hierarchy).
+
+    The hierarchical-aggregation unit: a wave's client block reduces
+    on-device to a weighted delta sum + weight/loss/participant counts,
+    already psum'd across the mesh (replicated). Partials from successive
+    waves of the same round ADD (``accumulate_partial``), and the round
+    closes with ``make_apply_partial`` — θ never meets more than one
+    wave's client data in HBM. A flat round is the 1-wave special case;
+    ``make_fed_round`` computes exactly these four values internally
+    before applying the update, so flat and hierarchical share one
+    per-client code path by construction.
+    """
+
+    update_sum: object  # pytree like θ: Σ masked weighted client deltas
+    weight_sum: jax.Array
+    loss_sum: jax.Array  # Σ weight·loss (mean = loss_sum / weight_sum)
+    num_participants: jax.Array
+
+
+def hier_enabled() -> bool:
+    """Route streamed rounds through the hierarchical partial/apply pair?
+
+    ``QFEDX_HIER`` (``0``/``off``/``1``/``on``, default on) pins the
+    choice at BUILD time for the streamed trainer: on, a round is W
+    partial dispatches + one apply (cohort size unbounded by HBM); off
+    forces the flat one-program round, which requires the whole cohort
+    resident in one wave — the parity lever (streamed results match the
+    flat program bit-for-bit at one wave; see tests/test_hier.py).
+    """
+    return pins.bool_pin("QFEDX_HIER", True)
+
+
 def fold_clients_enabled(model: Model, cfg: FedConfig) -> bool:
     """Fold the client axis into the engine batch instead of vmapping the
     local update over C clients?
@@ -104,37 +137,37 @@ def donate_enabled() -> bool:
     )
 
 
-def make_fed_round(
+def _make_per_device_partial(
     model: Model,
     cfg: FedConfig,
-    mesh: Mesh,
-    num_clients: int,
-    axis: str = "clients",
-    donate: bool = False,
+    wave_clients: int,
+    cohort_clients: int,
+    axis: str,
+    axis_size: int,
 ):
-    """Build ``round_fn(params, cx, cy, cmask, round_key) -> (params, stats)``.
+    """Shared per-device body of the flat AND hierarchical round programs.
 
-    ``cx/cy/cmask``: packed client data [C, S, ...] sharded over ``axis``;
-    C must be divisible by the mesh axis size (block of C/D clients per
-    device — SURVEY.md §7.3.5's inner vmap over a client block).
-    ``donate=True`` donates the ``params`` argument's buffer to the
-    dispatch — the caller's input arrays are DELETED on call; only pass
-    buffers you re-derive from the output. Default OFF: direct callers
-    commonly reuse a params buffer after a round call, which donation
-    would invalidate on accelerator backends. The trainer opts in via
-    ``donate_enabled()`` (the QFEDX_DONATE pin).
+    Computes one wave's ``RoundPartial`` (weighted delta sum + counts,
+    psum'd over ``axis``). ``wave_clients`` is the wave resident on the
+    mesh for this dispatch; ``cohort_clients`` is the ROUND's global
+    cohort — sampling, DP keys and secure-agg pair graphs are all drawn
+    over the cohort, so ring masks pair a wave's clients with neighbors
+    that may live in OTHER waves and cancel only in the cross-wave sum
+    (the hierarchy-wide cancellation the r10 tentpole requires). A flat
+    round is the special case wave == cohort, wave_base == 0 — one code
+    path, parity by construction.
     """
     local_update = make_local_update(model, cfg)
     folded = fold_clients_enabled(model, cfg)
     local_update_c = (
         make_local_update_clients(model, cfg) if folded else None
     )
-    axis_size = mesh.shape[axis]
-    if num_clients % axis_size != 0:
+    if wave_clients % axis_size != 0:
         raise ValueError(
-            f"num_clients={num_clients} not divisible by mesh axis {axis}={axis_size}"
+            f"num_clients={wave_clients} not divisible by mesh axis {axis}={axis_size}"
         )
-    block = num_clients // axis_size
+    block = wave_clients // axis_size
+    num_clients = cohort_clients
 
     # Phase seams below carry two kinds of names: ``jax.named_scope``
     # tags the emitted ops so XLA-level profiles (--profile /
@@ -142,10 +175,12 @@ def make_fed_round(
     # sampling/local_update/dp/secure-agg/aggregate, and ``obs.span``
     # (QFEDX_TRACE-gated, trace-time only — this function runs under
     # jit) records where TRACE-BUILD wall goes, once per compile.
-    def per_device(params, cx, cy, cmask, round_key):
+    def per_device_partial(params, cx, cy, cmask, wave_base, round_key):
         # Local block shapes: cx [block, S, ...]; params replicated.
+        # Client ids are COHORT positions: wave_base offsets this wave's
+        # block into the round's global cohort.
         dev = jax.lax.axis_index(axis)
-        client_ids = dev * block + jnp.arange(block)
+        client_ids = wave_base + dev * block + jnp.arange(block)
         with obs.span("fed.trace.sampling"), jax.named_scope("sampling"):
             part = participation_mask(
                 round_key, num_clients, cfg.client_fraction
@@ -228,26 +263,70 @@ def make_fed_round(
                     client_ids, cx, cy, cmask
                 )
 
-        # Reduce the local client block, then all-reduce across chips.
+        # Reduce the local client block, then all-reduce across chips —
+        # the per-chip partial aggregate of the hierarchy.
         with obs.span("fed.trace.aggregate"), jax.named_scope("aggregate"):
             block_sum = jax.tree.map(lambda t: jnp.sum(t, axis=0), contribs)
             update_sum = jax.lax.psum(block_sum, axis)
             weight_sum = jax.lax.psum(jnp.sum(weights), axis)
             loss_sum = jax.lax.psum(jnp.sum(weights * losses), axis)
             n_part = jax.lax.psum(jnp.sum(part[client_ids]), axis)
+        return RoundPartial(
+            update_sum=update_sum,
+            weight_sum=weight_sum,
+            loss_sum=loss_sum,
+            num_participants=n_part,
+        )
 
-            denom = jnp.maximum(weight_sum, 1e-12)
-            new_params = jax.tree.map(
-                lambda p, u: (p + u / denom).astype(p.dtype),
-                params,
-                update_sum,
-            )
-            stats = RoundStats(
-                mean_loss=loss_sum / denom,
-                total_weight=weight_sum,
-                num_participants=n_part,
-            )
-        return new_params, stats
+    return per_device_partial
+
+
+def _finalize_partial(params, partial: RoundPartial):
+    """θ_new = θ + Σ wΔ / Σ w — the hierarchy's root combine, shared
+    verbatim between the flat round (inline) and ``make_apply_partial``
+    (its own dispatch after the last wave)."""
+    denom = jnp.maximum(partial.weight_sum, 1e-12)
+    new_params = jax.tree.map(
+        lambda p, u: (p + u / denom).astype(p.dtype),
+        params,
+        partial.update_sum,
+    )
+    stats = RoundStats(
+        mean_loss=partial.loss_sum / denom,
+        total_weight=partial.weight_sum,
+        num_participants=partial.num_participants,
+    )
+    return new_params, stats
+
+
+def make_fed_round(
+    model: Model,
+    cfg: FedConfig,
+    mesh: Mesh,
+    num_clients: int,
+    axis: str = "clients",
+    donate: bool = False,
+):
+    """Build ``round_fn(params, cx, cy, cmask, round_key) -> (params, stats)``.
+
+    ``cx/cy/cmask``: packed client data [C, S, ...] sharded over ``axis``;
+    C must be divisible by the mesh axis size (block of C/D clients per
+    device — SURVEY.md §7.3.5's inner vmap over a client block).
+    ``donate=True`` donates the ``params`` argument's buffer to the
+    dispatch — the caller's input arrays are DELETED on call; only pass
+    buffers you re-derive from the output. Default OFF: direct callers
+    commonly reuse a params buffer after a round call, which donation
+    would invalidate on accelerator backends. The trainer opts in via
+    ``donate_enabled()`` (the QFEDX_DONATE pin).
+    """
+    per_partial = _make_per_device_partial(
+        model, cfg, num_clients, num_clients, axis, mesh.shape[axis]
+    )
+
+    def per_device(params, cx, cy, cmask, round_key):
+        partial = per_partial(params, cx, cy, cmask, 0, round_key)
+        with jax.named_scope("aggregate"):
+            return _finalize_partial(params, partial)
 
     sharded = shard_map(
         per_device,
@@ -257,6 +336,70 @@ def make_fed_round(
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_fed_round_partial(
+    model: Model,
+    cfg: FedConfig,
+    mesh: Mesh,
+    wave_clients: int,
+    cohort_clients: int | None = None,
+    axis: str = "clients",
+):
+    """Build ``partial_fn(params, cx, cy, cmask, wave_base, round_key) ->
+    RoundPartial`` — one WAVE of the hierarchical round.
+
+    ``cx/cy/cmask``: the wave's packed client data [wave_clients, S, ...]
+    sharded over ``axis``. ``wave_base`` is a TRACED int32 scalar (one
+    compiled program serves every wave): this wave covers cohort
+    positions ``[wave_base, wave_base + wave_clients)`` of a round whose
+    global cohort holds ``cohort_clients`` clients (default: one wave is
+    the whole cohort). Sampling, per-client DP noise keys and secure-agg
+    pair graphs all run over the COHORT, so masks cancel across waves
+    (`fed/secure_agg.py`) and a W-wave round equals the flat round over
+    the same W·C clients up to summation order (pinned, with tolerance,
+    in tests/test_hier.py; one wave is bit-exact). No donation: θ must
+    survive every wave of the round until ``make_apply_partial``.
+    """
+    cohort = wave_clients if cohort_clients is None else cohort_clients
+    per_partial = _make_per_device_partial(
+        model, cfg, wave_clients, cohort, axis, mesh.shape[axis]
+    )
+    sharded = shard_map(
+        per_partial,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(axis), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def make_accumulate_partial(donate: bool = False):
+    """Jitted ``accum(acc, partial) -> RoundPartial`` leaf-wise add —
+    folds wave w's partial into the round's running aggregate.
+    ``donate=True`` donates ``acc`` (the natural use rechains the
+    output; θ-sized, so donation is a micro-optimization — follow
+    ``donate_enabled()``'s CPU caution)."""
+
+    def accum(acc: RoundPartial, partial: RoundPartial) -> RoundPartial:
+        return jax.tree.map(jnp.add, acc, partial)
+
+    return jax.jit(accum, donate_argnums=(0,) if donate else ())
+
+
+def make_apply_partial():
+    """Jitted ``apply_fn(params, partial) -> (params, stats)`` — the
+    hierarchy's root: apply the cross-wave accumulated ``RoundPartial``
+    to θ. Ops match the flat round's in-program finalize exactly
+    (``_finalize_partial`` is shared), so a 1-wave partial + apply
+    reproduces ``make_fed_round`` bit-for-bit (tests/test_hier.py)."""
+
+    def apply_fn(params, partial: RoundPartial):
+        with jax.named_scope("aggregate"):
+            return _finalize_partial(params, partial)
+
+    return jax.jit(apply_fn)
 
 
 def make_fed_rounds(

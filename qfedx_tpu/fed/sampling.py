@@ -1,19 +1,36 @@
-"""Client sampling with static program shape.
+"""Client sampling: registry → cohort (host) and cohort → participants
+(in-program).
 
-Reference spec: client fraction p ∈ {0.1, 0.3, 1.0} (ROADMAP.md:106) with
-server-side sampling (ROADMAP.md:35). Under SPMD every client trains every
-round (the program shape is static — SURVEY.md §7.3.2); sampling is a 0/1
-participation mask applied to aggregation weights, derived deterministically
-from the replicated round key so every device agrees on the cohort without
-communication. Unsampled clients do dead work (masked out), which is the
-standard static-shape trade: at full participation (the reference default)
-there is no waste at all.
+Two composable stages since r10:
+
+1. **``CohortSampler``** (host, numpy): per-round selection of which
+   registry clients form this round's cohort at all — the gate that lets
+   a round draw from a simulated registry of 10⁶+ clients while only the
+   sampled cohort's data is ever materialized (``data/stream.py``). Each
+   round's draw is a pure function of ``(seed, round_idx)`` — no
+   internal state advances — so a run resumed at round r reproduces
+   rounds r, r+1, … exactly (the checkpoint-resume determinism contract,
+   pinned in tests/test_stream.py).
+2. **``participation_mask``** (in-program): reference spec client
+   fraction p ∈ {0.1, 0.3, 1.0} (ROADMAP.md:106) with server-side
+   sampling (ROADMAP.md:35). Under SPMD every cohort client trains every
+   round (the program shape is static — SURVEY.md §7.3.2); sampling is a
+   0/1 participation mask applied to aggregation weights, derived
+   deterministically from the replicated round key so every device
+   agrees on the participants without communication. Unsampled clients
+   do dead work (masked out), which is the standard static-shape trade:
+   at full participation (the reference default) there is no waste at
+   all. Under the r10 hierarchy the mask spans the COHORT, not the wave,
+   so secure-agg pair graphs drawn from it cancel across waves.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def participation_mask(
@@ -25,3 +42,40 @@ def participation_mask(
     return jax.random.bernoulli(
         jax.random.fold_in(round_key, 0x5A3D), fraction, (num_clients,)
     ).astype(jnp.float32)
+
+
+@dataclass(frozen=True)
+class CohortSampler:
+    """Seeded, resumable per-round cohort draw from a client registry.
+
+    ``round_ids(r)`` returns the ``cohort_size`` registry ids forming
+    round r's cohort — without replacement, ascending (the cohort
+    POSITION order every in-program stage indexes by: participation,
+    DP noise keys, secure-agg rings). Statelessness is the point:
+    round r's draw derives from ``(seed, r)`` alone, never from how many
+    draws preceded it, so crash/resume at any round replays the exact
+    cohort sequence (no sampler state in the checkpoint) and two hosts
+    agree without communication. ``cohort_size == registry_size``
+    short-circuits to all clients in id order — the flat path's layout,
+    byte-identical to ``pack_clients`` ordering.
+    """
+
+    registry_size: int
+    cohort_size: int
+    seed: int = 0
+
+    def __post_init__(self):
+        if not (1 <= self.cohort_size <= self.registry_size):
+            raise ValueError(
+                f"cohort_size={self.cohort_size} must be in "
+                f"[1, registry_size={self.registry_size}]"
+            )
+
+    def round_ids(self, round_idx: int) -> np.ndarray:
+        if round_idx < 0:
+            raise ValueError(f"round_idx must be >= 0, got {round_idx}")
+        if self.cohort_size == self.registry_size:
+            return np.arange(self.registry_size, dtype=np.int64)
+        rng = np.random.default_rng([self.seed, int(round_idx)])
+        ids = rng.choice(self.registry_size, self.cohort_size, replace=False)
+        return np.sort(ids.astype(np.int64))

@@ -12,7 +12,6 @@ checkpoint-every-K-rounds with resume (ROADMAP.md:90-91), and JSONL metrics
 
 from __future__ import annotations
 
-import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -78,18 +77,7 @@ def resolve_pipeline_depth(pipeline_depth: int | None = None) -> int:
         if depth < 0:
             raise ValueError(f"pipeline_depth must be >= 0, got {depth}")
         return depth
-    env = os.environ.get("QFEDX_PIPELINE")
-    if env is None:
-        return 1
-    as_bool = pins.parse_onoff(env)
-    if as_bool is not None:
-        return 1 if as_bool else 0
-    if env.isdigit():
-        return int(env)
-    raise ValueError(
-        f"QFEDX_PIPELINE={env!r}: expected '0'/'off', '1'/'on' or an "
-        "integer depth"
-    )
+    return pins.depth_pin("QFEDX_PIPELINE", 1)
 
 
 def train_federated(
@@ -627,4 +615,261 @@ def train_federated(
         result.accuracies[-1] = evaluate_full(params, test_x, test_y)[
             "accuracy"
         ]
+    return result
+
+
+def train_federated_streamed(
+    model: Model,
+    cfg: FedConfig,
+    registry,
+    test_x: np.ndarray,
+    test_y: np.ndarray,
+    *,
+    cohort_size: int,
+    wave_size: int | None = None,
+    num_rounds: int = 30,
+    seed: int = 42,
+    mesh=None,
+    eval_every: int = 1,
+    eval_batches: int | None = None,
+    on_round_end: Callable[[int, dict], None] | None = None,
+    checkpointer=None,
+    stream_depth: int | None = None,
+) -> TrainResult:
+    """Federated training over a client REGISTRY — unbounded cohorts via
+    hierarchical aggregation + streamed wave ingestion (the r10 tentpole).
+
+    Where ``train_federated`` needs the whole cohort's packed data
+    resident in HBM for the round program, this loop samples each
+    round's ``cohort_size`` clients from ``registry`` (any object with
+    ``num_clients`` + ``batch(ids)`` — ``data.stream.SyntheticRegistry``
+    simulates 10⁶+ clients, ``ArrayRegistry`` wraps packed arrays),
+    splits the cohort into ``wave_size``-client waves, and runs each
+    wave through ``fed.round.make_fed_round_partial``: per-chip partial
+    aggregates (weighted Δ sum + counts) combine across the mesh by
+    psum and across waves by on-device accumulation, and θ updates once
+    per round (``make_apply_partial``). Peak HBM holds ONE wave's data
+    (plus ``stream_depth`` staged uploads), not the cohort's — a round
+    processes W × C clients with C resident.
+
+    Correctness composition: secure-agg pair graphs and the
+    participation mask span the COHORT, so ring masks cancel across
+    waves (tests/test_hier.py pins streamed ≡ flat); cohort selection is
+    ``fed.sampling.CohortSampler`` — stateless in the round index, so
+    resume replays identical cohorts. The DP accountant sees the true
+    global cohort: with client-mode DP the per-round sampling rate is
+    ``client_fraction · cohort_size / registry.num_clients`` (cohort
+    subsampling is real privacy amplification — the registry is the
+    population). ``comm_mb_per_round`` reports the HIERARCHICAL wire
+    volume: W per-chip partial uplinks of |θ| plus one broadcast —
+    (W+1)·|θ| bytes — not C× full client deltas.
+
+    ``stream_depth``/``QFEDX_STREAM`` (see ``data.stream``): 0 uploads
+    waves synchronously; ≥ 1 (default 1) stages uploads on a background
+    thread so wave w+1's ``ingest.h2d`` overlaps wave w's
+    ``round.dispatch``. ``QFEDX_HIER=off`` forces the flat one-program
+    round (requires wave_size == cohort_size) — the parity lever.
+    Restricted to host-callable models (``model.sv_size == 1``); the
+    sv-sharded composition keeps the resident path.
+    """
+    from qfedx_tpu.data.stream import WaveStream
+    from qfedx_tpu.fed.round import (
+        hier_enabled,
+        make_accumulate_partial,
+        make_apply_partial,
+        make_fed_round_partial,
+    )
+    from qfedx_tpu.fed.sampling import CohortSampler
+
+    if model.sv_size != 1:
+        raise ValueError(
+            "train_federated_streamed needs a host-callable model "
+            "(sv_size == 1); sv-sharded models keep the resident path"
+        )
+    wave_size = cohort_size if wave_size is None else int(wave_size)
+    if cohort_size % wave_size != 0:
+        raise ValueError(
+            f"cohort_size={cohort_size} not divisible by wave_size={wave_size}"
+        )
+    num_waves = cohort_size // wave_size
+    hier = hier_enabled()
+    if not hier and num_waves > 1:
+        raise ValueError(
+            "QFEDX_HIER=off forces the flat one-program round, which "
+            f"needs the whole cohort in one wave (waves={num_waves})"
+        )
+    if mesh is None:
+        n_dev = min(len(jax.devices()), wave_size)
+        while wave_size % n_dev != 0:
+            n_dev -= 1
+        mesh = client_mesh(num_devices=n_dev)
+
+    sampler = CohortSampler(
+        registry_size=registry.num_clients, cohort_size=cohort_size,
+        seed=seed,
+    )
+    if hier:
+        partial_fn = make_fed_round_partial(
+            model, cfg, mesh, wave_clients=wave_size,
+            cohort_clients=cohort_size,
+        )
+        accum_fn = make_accumulate_partial()
+        apply_fn = make_apply_partial()
+        round_fn = None
+    else:
+        partial_fn = accum_fn = apply_fn = None
+        round_fn = make_fed_round(
+            model, cfg, mesh, num_clients=cohort_size
+        )
+
+    evaluate = make_evaluator(model, max_batches=eval_batches)
+    evaluate_full = make_evaluator(model)
+
+    key = jax.random.PRNGKey(seed)
+    init_key, round_key_base = jax.random.split(key)
+    with obs.span("trainer.init"):
+        params = model.init(init_key)
+        start_round = 0
+        if checkpointer is not None:
+            restored = checkpointer.restore_latest(params)
+            if restored is not None:
+                params, start_round = restored
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    params = jax.device_put(params, NamedSharding(mesh, P()))
+
+    accountant = RDPAccountant() if cfg.dp is not None else None
+    if accountant is not None and cfg.dp.mode == "example":
+        # Per-LOCAL-step composition at q = B/S_pad, exactly the resident
+        # trainer's convention (cohort subsampling is conservatively NOT
+        # folded in — all of a round's local steps share one cohort
+        # draw, see train_federated).
+        s_pad = registry.batch(np.arange(1))[0].shape[1]
+        acct_q = min(1.0, cfg.batch_size / s_pad)
+        acct_steps = cfg.local_epochs * (s_pad // cfg.batch_size)
+    else:
+        # Client-mode DP: the mechanism touches a client this round only
+        # if the registry→cohort draw AND the in-program participation
+        # draw both select it — the TRUE per-round sampling rate over
+        # the registry population, which is what the subsampled-RDP
+        # bound amplifies over. With cohort == registry this reduces to
+        # the resident trainer's q = client_fraction.
+        acct_q = cfg.client_fraction * (
+            cohort_size / registry.num_clients
+        )
+        acct_steps = 1
+    if accountant is not None and start_round > 0:
+        accountant.step(
+            q=acct_q, sigma=cfg.dp.noise_multiplier,
+            num_steps=start_round * acct_steps,
+        )
+
+    # Hierarchical wire volume per round (the honest comm number under
+    # streaming): each wave uplinks ONE per-chip partial of |θ| (the
+    # psum), and θ broadcasts once — (W+1)·|θ| bytes, independent of
+    # cohort size. W = 1 reduces to the resident trainer's 2·|θ|.
+    comm_mb = (num_waves + 1) * trees.tree_bytes(params) / 1e6
+
+    result = TrainResult(
+        params=params,
+        accuracies=[],
+        losses=[],
+        comm_mb_per_round=comm_mb,
+        evaluate=evaluate_full,
+        mesh=mesh,
+    )
+    if eval_every <= num_rounds:
+        with obs.span("round.eval", round=0):
+            metrics0 = evaluate(params, test_x, test_y)
+        result.accuracies.append(metrics0["accuracy"])
+
+    for rnd in range(start_round, num_rounds):
+        t0 = time.perf_counter()
+        round_key = jax.random.fold_in(round_key_base, rnd)
+        cohort_ids = sampler.round_ids(rnd)
+        stream = WaveStream(
+            registry, mesh, cohort_ids, wave_size, depth=stream_depth
+        )
+        try:
+            # Dispatch wall covers the whole wave fan-in: JAX's async
+            # dispatch returns before compute finishes, so the host
+            # loops ahead issuing wave w+1 while wave w runs — and the
+            # stream's background H2D staging overlaps both (the
+            # ingest.h2d / round.dispatch overlap the trace shows).
+            with obs.span(
+                "round.dispatch", round=rnd + 1, waves=num_waves,
+                cohort=cohort_size,
+            ) as sp_dispatch:
+                if hier:
+                    acc = None
+                    for wave_base, (wx, wy, wm) in stream:
+                        part = partial_fn(
+                            params, wx, wy, wm, np.int32(wave_base),
+                            round_key,
+                        )
+                        acc = part if acc is None else accum_fn(acc, part)
+                    params, stats = apply_fn(params, acc)
+                else:
+                    wave_base, (wx, wy, wm) = next(iter(stream))
+                    params, stats = round_fn(params, wx, wy, wm, round_key)
+        finally:
+            stream.close()
+        with obs.span("round.fetch", round=rnd + 1) as sp_fetch:
+            stats_h = jax.device_get(stats)
+        dt = time.perf_counter() - t0
+
+        loss = float(np.asarray(stats_h.mean_loss))
+        result.round_times_s.append(dt)
+        result.losses.append(loss)
+        metrics = {
+            "round": rnd + 1,
+            "loss": loss,
+            "time_s": dt,
+            "cohort": cohort_size,
+            "waves": num_waves,
+            "participants": int(np.asarray(stats_h.num_participants)),
+        }
+        if accountant is not None:
+            accountant.step(
+                q=acct_q, sigma=cfg.dp.noise_multiplier,
+                num_steps=acct_steps,
+            )
+            eps = accountant.epsilon(cfg.dp.delta)
+            result.epsilons.append(eps)
+            metrics["epsilon"] = eps
+        sp_eval = None
+        if (rnd + 1) % eval_every == 0 or rnd == num_rounds - 1:
+            with obs.span("round.eval", round=rnd + 1) as sp_eval:
+                eval_metrics = evaluate(params, test_x, test_y)
+            result.accuracies.append(eval_metrics["accuracy"])
+            metrics.update(eval_metrics)
+        if checkpointer is not None:
+            with obs.span("round.checkpoint", round=rnd + 1):
+                if rnd == num_rounds - 1:
+                    checkpointer.wait()
+                    checkpointer.save(rnd + 1, params)
+                else:
+                    # Background writer (r09): the device→host snapshot
+                    # + atomic tmp/rename happen off the round loop, so
+                    # a checkpoint boundary doesn't stall the wave
+                    # stream; the final save above stays synchronous
+                    # behind wait() for durability/error surfacing.
+                    checkpointer.maybe_save_async(rnd + 1, params)
+        if obs.enabled():
+            phases = {
+                "dispatch_s": round(sp_dispatch.duration, 6),
+                "fetch_s": round(sp_fetch.duration, 6),
+            }
+            if sp_dispatch.compile_s > 0:
+                phases["compile_s"] = round(sp_dispatch.compile_s, 6)
+            if sp_eval is not None:
+                phases["eval_s"] = round(sp_eval.duration, 6)
+            metrics["phases"] = phases
+            mem = obs.record_device_memory()
+            if mem and "bytes_in_use" in mem:
+                metrics["mem_bytes_in_use"] = mem["bytes_in_use"]
+        if on_round_end is not None:
+            on_round_end(rnd, metrics)
+
+    result.params = params
     return result

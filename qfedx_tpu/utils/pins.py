@@ -46,3 +46,23 @@ def bool_pin(name: str, default: bool | Callable[[], bool]) -> bool:
     if val is None:
         raise ValueError(f"{name}={env!r}: expected '1'/'on' or '0'/'off'")
     return val
+
+
+def depth_pin(name: str, default: int, on_value: int = 1) -> int:
+    """Resolve an integer-depth pin with the on/off grammar as a prefix:
+    ``0``/``off`` → 0, ``1``/``on`` → ``on_value``, a bare integer → that
+    depth, anything else raises. QFEDX_PIPELINE (trainer loop depth) and
+    QFEDX_STREAM (ingest prefetch depth) share this shape — the two
+    host-loop depth knobs must not drift on spelling the way the bool
+    pins once did (module docstring)."""
+    env = os.environ.get(name)
+    if env is None:
+        return default
+    as_bool = parse_onoff(env)
+    if as_bool is not None:
+        return on_value if as_bool else 0
+    if env.isdigit():
+        return int(env)
+    raise ValueError(
+        f"{name}={env!r}: expected '0'/'off', '1'/'on' or an integer depth"
+    )

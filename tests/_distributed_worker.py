@@ -1,13 +1,22 @@
 """Subprocess worker for the 2-process ``jax.distributed`` parity test.
 
 Run as: ``python tests/_distributed_worker.py <coordinator> <nproc> <pid>
-<out_path>``. Each process owns ONE XLA:CPU device; cross-process CPU
-collectives use the gloo backend (``jax_cpu_collectives_implementation``
-— must be set before ``jax.distributed.initialize``). Initialization
-goes through ``parallel.mesh.distributed_init`` — the wrapper the
-multi-host story ships — then one federated round runs over the
-2-process global mesh and process 0 writes the resulting parameters +
-stats for the parent to compare against the single-process oracle.
+<out_path> [mode]``. Each process owns ONE XLA:CPU device; cross-process
+CPU collectives use the gloo backend
+(``jax_cpu_collectives_implementation`` — must be set before
+``jax.distributed.initialize``). Initialization goes through
+``parallel.mesh.distributed_init`` — the wrapper the multi-host story
+ships — then one federated round runs over the 2-process global mesh
+and process 0 writes the resulting parameters + stats for the parent to
+compare against the single-process oracle.
+
+``mode`` (default ``flat``): ``flat`` runs the one-program
+``make_fed_round``; ``hier`` runs the r10 hierarchical round — a
+4-client cohort in TWO waves of ``make_fed_round_partial`` (each wave's
+psum crosses the process boundary via gloo), accumulated and applied by
+``make_apply_partial`` — so cross-wave secure-agg mask cancellation is
+exercised over REAL cross-process collectives, not just the virtual
+mesh.
 """
 
 import os
@@ -16,6 +25,7 @@ import sys
 
 def main() -> None:
     coordinator, nproc, pid, out_path = sys.argv[1:5]
+    mode = sys.argv[5] if len(sys.argv) > 5 else "flat"
     os.environ["JAX_PLATFORMS"] = "cpu"
     # The parent test env forces 8 virtual devices; this worker must own
     # exactly one device so the mesh spans the PROCESS boundary.
@@ -53,9 +63,19 @@ def main() -> None:
     from qfedx_tpu.fed.round import make_fed_round
     from qfedx_tpu.models.vqc import make_vqc_classifier
 
-    num_clients, samples, n_q = 2, 8, 3
-    cfg = FedConfig(local_epochs=2, batch_size=4, learning_rate=0.1,
-                    optimizer="adam")
+    if mode == "hier":
+        # 4-client cohort split into 2 waves of 2 (one client per
+        # process per wave); sgd keeps the wave-split comparison
+        # float-tight (tests/test_hier.py's tolerance rationale), ring
+        # SA makes cross-wave mask cancellation the thing under test.
+        num_clients, samples, n_q = 4, 8, 3
+        cfg = FedConfig(local_epochs=2, batch_size=4, learning_rate=0.1,
+                        optimizer="sgd", secure_agg=True,
+                        secure_agg_mode="ring")
+    else:
+        num_clients, samples, n_q = 2, 8, 3
+        cfg = FedConfig(local_epochs=2, batch_size=4, learning_rate=0.1,
+                        optimizer="adam")
     model = make_vqc_classifier(n_qubits=n_q, n_layers=2, num_classes=2)
 
     # Deterministic data/keys: every process builds identical host values
@@ -79,12 +99,36 @@ def main() -> None:
         model.init(jax.random.PRNGKey(0)),
     )
     key = globalize(np.asarray(jax.random.PRNGKey(42)), P())
-    scx = globalize(cx, P("clients"))
-    scy = globalize(cy, P("clients"))
-    scm = globalize(cm, P("clients"))
 
-    round_fn = make_fed_round(model, cfg, mesh, num_clients=num_clients)
-    new_params, stats = round_fn(params, scx, scy, scm, key)
+    if mode == "hier":
+        from qfedx_tpu.fed.round import (
+            make_accumulate_partial,
+            make_apply_partial,
+            make_fed_round_partial,
+        )
+
+        wave = int(nproc)  # one client per process per wave
+        partial_fn = make_fed_round_partial(
+            model, cfg, mesh, wave_clients=wave, cohort_clients=num_clients
+        )
+        accum = make_accumulate_partial()
+        acc = None
+        for w in range(num_clients // wave):
+            sl = slice(w * wave, (w + 1) * wave)
+            wx = globalize(cx[sl], P("clients"))
+            wy = globalize(cy[sl], P("clients"))
+            wm = globalize(cm[sl], P("clients"))
+            wb = globalize(np.asarray(w * wave, dtype=np.int32), P())
+            part = partial_fn(params, wx, wy, wm, wb, key)
+            acc = part if acc is None else accum(acc, part)
+        new_params, stats = make_apply_partial()(params, acc)
+    else:
+        scx = globalize(cx, P("clients"))
+        scy = globalize(cy, P("clients"))
+        scm = globalize(cm, P("clients"))
+
+        round_fn = make_fed_round(model, cfg, mesh, num_clients=num_clients)
+        new_params, stats = round_fn(params, scx, scy, scm, key)
 
     if int(pid) == 0:
         leaves = {

@@ -1,0 +1,60 @@
+"""Tier-1 lint: the QFEDX_* pin surface and its docs table cannot drift.
+
+``benchmarks/check_pins.py`` holds the single definition (AST scan of
+exact pin-name literals vs the docs/OBSERVABILITY.md table rows); this
+test wires it into the suite so an undocumented pin — or a stale table
+row — fails CI, not a code review. The synthetic cases prove the guard
+actually fires in both directions.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from benchmarks.check_pins import (  # noqa: E402
+    check,
+    documented_pins,
+    source_pins,
+)
+
+
+def test_pin_table_matches_source():
+    assert check() == []
+
+
+def test_every_known_pin_family_member_is_seen():
+    # The scanner must at least find the pins the framework is built on;
+    # an empty scan would make the table check vacuously pass.
+    pins = source_pins()
+    for name in (
+        "QFEDX_DTYPE", "QFEDX_FOLD_CLIENTS", "QFEDX_FUSE", "QFEDX_TRACE",
+        "QFEDX_PIPELINE", "QFEDX_DONATE", "QFEDX_HIER", "QFEDX_STREAM",
+    ):
+        assert name in pins, f"scanner lost {name}"
+    assert len(documented_pins()) >= len(pins) - 1
+
+
+def test_guard_fires_both_directions(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'import os\n'
+        'val = os.environ.get("QFEDX_UNDOCUMENTED")\n'
+        '# prose mention of QFEDX_NOT_A_READ inside a comment is ignored\n'
+        'msg = "set QFEDX_EMBEDDED=1 to enable"  # embedded: ignored\n'
+    )
+    doc = tmp_path / "OBS.md"
+    doc.write_text(
+        "| pin | values |\n|---|---|\n"
+        "| `QFEDX_UNDOCUMENTED` | `0`/`1` |\n"
+    )
+    assert check(pkg, doc) == []  # documented read + ignored prose: clean
+    doc.write_text(
+        "| pin | values |\n|---|---|\n| `QFEDX_STALE_ROW` | `0`/`1` |\n"
+    )
+    problems = check(pkg, doc)
+    assert any("QFEDX_UNDOCUMENTED" in p for p in problems)
+    assert any("QFEDX_STALE_ROW" in p for p in problems)

@@ -32,10 +32,10 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_round_matches_single_process(tmp_path):
+def _run_workers(out_path: str, mode: str) -> "np.lib.npyio.NpzFile":
+    """Spawn the 2-process gloo worker pair and return process 0's saved
+    result arrays."""
     port = _free_port()
-    out_path = str(tmp_path / "dist_result.npz")
     env = {
         k: v
         for k, v in os.environ.items()
@@ -51,6 +51,7 @@ def test_two_process_round_matches_single_process(tmp_path):
                 "2",
                 str(pid),
                 out_path,
+                mode,
             ],
             env=env,
             stdout=subprocess.PIPE,
@@ -71,7 +72,12 @@ def test_two_process_round_matches_single_process(tmp_path):
     for p, out in zip(procs, outputs):
         assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
     assert os.path.exists(out_path)
-    got = np.load(out_path)
+    return np.load(out_path)
+
+
+@pytest.mark.slow
+def test_two_process_round_matches_single_process(tmp_path):
+    got = _run_workers(str(tmp_path / "dist_result.npz"), "flat")
 
     # Single-process oracle: the identical round (same model/config/data/
     # keys, 2 clients on a 2-device mesh — one block per device, exactly
@@ -105,6 +111,55 @@ def test_two_process_round_matches_single_process(tmp_path):
     for i, ref in enumerate(ref_leaves):
         np.testing.assert_allclose(
             got[f"leaf{i}"], np.asarray(ref), atol=1e-6, rtol=0
+        )
+    np.testing.assert_allclose(
+        got["mean_loss"], np.asarray(ref_stats.mean_loss), atol=1e-5
+    )
+    assert float(got["total_weight"]) == float(ref_stats.total_weight)
+
+
+@pytest.mark.slow
+def test_two_process_hier_round_matches_flat_single_process(tmp_path):
+    """r10 hierarchy over REAL cross-process collectives: the worker pair
+    runs a 4-client cohort as TWO waves of ``make_fed_round_partial``
+    (each wave's partial psum crosses the process boundary via gloo),
+    accumulates and applies; the oracle is the FLAT one-program round on
+    the virtual 2-device mesh. Ring secure-agg is on, so a wave's masks
+    pair with clients in the OTHER wave — cancellation must survive both
+    the wave split and the process boundary. sgd keeps the wave-split
+    comparison float-tight (tests/test_hier.py's tolerance rationale)."""
+    got = _run_workers(str(tmp_path / "dist_hier_result.npz"), "hier")
+
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.fed.round import (
+        client_mesh,
+        make_fed_round,
+        shard_client_data,
+    )
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    num_clients, samples, n_q = 4, 8, 3
+    cfg = FedConfig(local_epochs=2, batch_size=4, learning_rate=0.1,
+                    optimizer="sgd", secure_agg=True,
+                    secure_agg_mode="ring")
+    model = make_vqc_classifier(n_qubits=n_q, n_layers=2, num_classes=2)
+    rng = np.random.default_rng(0)
+    cx = rng.uniform(0, 1, (num_clients, samples, n_q)).astype(np.float32)
+    cy = rng.integers(0, 2, (num_clients, samples)).astype(np.int32)
+    cm = np.ones((num_clients, samples), dtype=np.float32)
+    mesh = client_mesh(num_devices=2)
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+    params = model.init(jax.random.PRNGKey(0))
+    round_fn = make_fed_round(model, cfg, mesh, num_clients=num_clients)
+    ref_params, ref_stats = round_fn(
+        params, scx, scy, scm, jax.random.PRNGKey(42)
+    )
+
+    ref_leaves = jax.tree.leaves(ref_params)
+    assert len(ref_leaves) == sum(1 for k in got.files if k.startswith("leaf"))
+    for i, ref in enumerate(ref_leaves):
+        np.testing.assert_allclose(
+            got[f"leaf{i}"], np.asarray(ref), atol=2e-5, rtol=0
         )
     np.testing.assert_allclose(
         got["mean_loss"], np.asarray(ref_stats.mean_loss), atol=1e-5

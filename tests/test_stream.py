@@ -1,0 +1,390 @@
+"""Streamed ingestion + cohort sampling (r10): determinism, overlap,
+trainer parity, resume.
+
+Covers the host half of the unbounded-cohort tentpole:
+
+- ``fed.sampling.CohortSampler`` — seeded, RESUMABLE per-round draws: a
+  run resumed at round r must replay rounds r, r+1, … with identical
+  cohorts (the test_run_io-style matrix below), because the draw is a
+  pure function of (seed, round), never of sampler call history.
+- ``data.stream`` — registries are deterministic per client id
+  (wherever/whenever fetched), the wave uploader preserves order and
+  content at every depth, propagates worker errors, and at depth ≥ 1
+  genuinely overlaps: an ``ingest.h2d`` span from the uploader thread
+  lands strictly INSIDE the round's ``round.dispatch`` span (the
+  acceptance criterion's trace shape, pinned structurally via queue
+  semantics — wave 2's upload cannot start before wave 0 is consumed,
+  which happens inside the dispatch).
+- ``run.trainer.train_federated_streamed`` — one-wave streaming over an
+  ArrayRegistry is bit-identical to the resident ``train_federated`` on
+  the same bytes; results are depth-invariant; crash/resume through the
+  Checkpointer replays identically (sampler + key derivation both
+  stateless in the round index).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from qfedx_tpu import obs
+from qfedx_tpu.data.stream import (
+    ArrayRegistry,
+    SyntheticRegistry,
+    WaveStream,
+    resolve_stream_depth,
+)
+from qfedx_tpu.fed.config import DPConfig, FedConfig
+from qfedx_tpu.fed.round import client_mesh
+from qfedx_tpu.fed.sampling import CohortSampler
+from qfedx_tpu.models.vqc import make_vqc_classifier
+from qfedx_tpu.run.trainer import train_federated, train_federated_streamed
+
+N_Q = 3
+
+
+def _data(C=16, S=4, seed=0):
+    rng = np.random.default_rng(seed)
+    cx = rng.uniform(0, 1, (C, S, N_Q)).astype(np.float32)
+    cy = (cx.mean(axis=2) > 0.5).astype(np.int32)
+    cm = np.ones((C, S), dtype=np.float32)
+    return cx, cy, cm
+
+
+def _model():
+    return make_vqc_classifier(n_qubits=N_Q, n_layers=1, num_classes=2)
+
+
+def _test_set(n=32, seed=9):
+    rng = np.random.default_rng(seed)
+    tx = rng.uniform(0, 1, (n, N_Q)).astype(np.float32)
+    ty = (tx.mean(axis=1) > 0.5).astype(np.int32)
+    return tx, ty
+
+
+# --- CohortSampler ----------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "registry_size,cohort_size",
+    [(64, 16), (1000, 64), (1 << 20, 256), (32, 32)],
+)
+def test_sampler_resume_determinism(registry_size, cohort_size):
+    """The determinism-across-resume matrix: a fresh sampler (as a
+    resumed run would build) reproduces any round's cohort exactly; ids
+    are unique, sorted, in-range; different rounds/seed differ."""
+    s1 = CohortSampler(registry_size, cohort_size, seed=7)
+    draws = [s1.round_ids(r) for r in range(6)]
+    s2 = CohortSampler(registry_size, cohort_size, seed=7)
+    for r in (5, 3, 0):  # out of order — resume never replays history
+        np.testing.assert_array_equal(draws[r], s2.round_ids(r))
+    for ids in draws:
+        assert len(ids) == cohort_size
+        assert len(np.unique(ids)) == cohort_size
+        assert ids.min() >= 0 and ids.max() < registry_size
+        assert np.all(np.diff(ids) > 0)  # sorted = cohort position order
+    if cohort_size < registry_size:
+        assert not np.array_equal(draws[0], draws[1])
+        s3 = CohortSampler(registry_size, cohort_size, seed=8)
+        assert not np.array_equal(draws[0], s3.round_ids(0))
+    else:
+        np.testing.assert_array_equal(draws[0], np.arange(registry_size))
+
+
+def test_sampler_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        CohortSampler(8, 16)
+    with pytest.raises(ValueError):
+        CohortSampler(8, 0)
+    with pytest.raises(ValueError):
+        CohortSampler(8, 4).round_ids(-1)
+
+
+# --- registries -------------------------------------------------------------
+
+
+def test_synthetic_registry_deterministic_per_client():
+    """A client's data is identical whichever batch it is fetched in —
+    the property that makes 10⁶ simulated clients free AND resumable."""
+    reg = SyntheticRegistry(1 << 20, samples=4, n_features=N_Q, seed=3)
+    a = reg.batch(np.array([5, 999_999, 12]))
+    b = reg.batch(np.array([999_999]))
+    np.testing.assert_array_equal(a[0][1], b[0][0])
+    np.testing.assert_array_equal(a[1][1], b[1][0])
+    # different clients / seeds actually differ; features in [0, 1)
+    assert not np.array_equal(a[0][0], a[0][2])
+    c = SyntheticRegistry(1 << 20, samples=4, n_features=N_Q, seed=4).batch(
+        np.array([5])
+    )
+    assert not np.array_equal(a[0][0], c[0][0])
+    assert a[0].min() >= 0.0 and a[0].max() < 1.0
+    with pytest.raises(ValueError):
+        reg.batch(np.array([1 << 20]))
+
+
+def test_array_registry_slices():
+    cx, cy, cm = _data()
+    reg = ArrayRegistry(cx, cy, cm)
+    assert reg.num_clients == 16
+    bx, by, bm = reg.batch(np.array([3, 0]))
+    np.testing.assert_array_equal(bx[0], cx[3])
+    np.testing.assert_array_equal(by[1], cy[0])
+    np.testing.assert_array_equal(bm[0], cm[3])
+
+
+# --- WaveStream -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("depth", [0, 1, 3])
+def test_wave_stream_order_and_content(depth):
+    cx, cy, cm = _data(C=16)
+    reg = ArrayRegistry(cx, cy, cm)
+    mesh = client_mesh(num_devices=4)
+    ids = np.arange(16)
+    stream = WaveStream(reg, mesh, ids, wave_size=4, depth=depth)
+    seen = []
+    for wave_base, (wx, wy, wm) in stream:
+        seen.append(wave_base)
+        np.testing.assert_array_equal(
+            np.asarray(wx), cx[wave_base:wave_base + 4]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(wy), cy[wave_base:wave_base + 4]
+        )
+    assert seen == [0, 4, 8, 12]
+    stream.close()  # idempotent on a consumed stream
+
+
+def test_close_midstream_neither_stalls_nor_leaks_thread():
+    """Early consumer exit (the trainer's finally-close on a mid-round
+    error): close() must not deadlock against the uploader's terminal
+    sentinel put on a full queue — the thread exits promptly instead of
+    leaking with staged device buffers."""
+    import time
+
+    reg = ArrayRegistry(*_data(C=16))
+    mesh = client_mesh(num_devices=4)
+    stream = WaveStream(reg, mesh, np.arange(16), wave_size=4, depth=1)
+    next(stream)  # uploader is now racing ahead of the consumer
+    t0 = time.perf_counter()
+    stream.close()
+    assert time.perf_counter() - t0 < 2.0
+    assert stream._thread is not None and not stream._thread.is_alive()
+
+
+def test_wave_stream_validates_divisibility():
+    reg = ArrayRegistry(*_data(C=16))
+    mesh = client_mesh(num_devices=4)
+    with pytest.raises(ValueError):
+        WaveStream(reg, mesh, np.arange(16), wave_size=5)
+    with pytest.raises(ValueError):  # wave not divisible by mesh axis
+        WaveStream(reg, mesh, np.arange(16), wave_size=2)
+
+
+def test_wave_stream_propagates_worker_errors():
+    class Exploding:
+        num_clients = 16
+
+        def batch(self, ids):
+            if ids[0] >= 8:
+                raise RuntimeError("registry fetch failed")
+            cx, cy, cm = _data(C=16)
+            return cx[ids], cy[ids], cm[ids]
+
+    mesh = client_mesh(num_devices=4)
+    stream = WaveStream(Exploding(), mesh, np.arange(16), wave_size=4,
+                        depth=1)
+    got = [next(stream), next(stream)]
+    assert [g[0] for g in got] == [0, 4]
+    with pytest.raises(RuntimeError, match="registry fetch failed"):
+        for _ in stream:
+            pass
+    stream.close()
+
+
+def test_stream_depth_pin(monkeypatch):
+    monkeypatch.delenv("QFEDX_STREAM", raising=False)
+    assert resolve_stream_depth() == 1
+    monkeypatch.setenv("QFEDX_STREAM", "off")
+    assert resolve_stream_depth() == 0
+    monkeypatch.setenv("QFEDX_STREAM", "3")
+    assert resolve_stream_depth() == 3
+    assert resolve_stream_depth(0) == 0  # explicit arg wins
+    monkeypatch.setenv("QFEDX_STREAM", "fast")
+    with pytest.raises(ValueError):
+        resolve_stream_depth()
+    with pytest.raises(ValueError):
+        resolve_stream_depth(-1)
+
+
+# --- streamed trainer -------------------------------------------------------
+
+
+def test_streamed_one_wave_matches_resident_trainer():
+    """Full-cohort single-wave streaming ≡ the resident trainer on the
+    same packed arrays, bit-for-bit (same programs, same keys, same
+    cohort order) — the depth-0/flat reproduction contract."""
+    cx, cy, cm = _data()
+    tx, ty = _test_set()
+    model = _model()
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.1, optimizer="adam",
+        client_fraction=0.5, secure_agg=True, secure_agg_mode="ring",
+    )
+    res_flat = train_federated(
+        model, cfg, cx, cy, cm, tx, ty, num_rounds=2, seed=5, eval_every=1,
+    )
+    res_s = train_federated_streamed(
+        model, cfg, ArrayRegistry(cx, cy, cm), tx, ty,
+        cohort_size=16, num_rounds=2, seed=5, eval_every=1,
+    )
+    for a, b in zip(
+        jax.tree.leaves(res_flat.params), jax.tree.leaves(res_s.params)
+    ):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert res_flat.losses == res_s.losses
+    assert res_flat.accuracies == res_s.accuracies
+
+
+def test_streamed_depth_invariance_and_wave_split():
+    """Results are identical at any prefetch depth (streaming changes
+    WHEN H2D happens, never what is computed), and a 4-wave split stays
+    within the documented wave-split tolerance of the 1-wave result."""
+    cx, cy, cm = _data(seed=2)
+    tx, ty = _test_set()
+    model = _model()
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.1, optimizer="sgd",
+        secure_agg=True, secure_agg_mode="ring",
+    )
+    reg = ArrayRegistry(cx, cy, cm)
+    mesh = client_mesh(num_devices=4)
+
+    def run(wave_size, depth):
+        return train_federated_streamed(
+            model, cfg, reg, tx, ty, cohort_size=16, wave_size=wave_size,
+            num_rounds=2, seed=3, eval_every=3, mesh=mesh,
+            stream_depth=depth,
+        )
+
+    r_d0 = run(4, 0)
+    r_d2 = run(4, 2)
+    for a, b in zip(
+        jax.tree.leaves(r_d0.params), jax.tree.leaves(r_d2.params)
+    ):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    r_whole = run(16, 1)
+    for a, b in zip(
+        jax.tree.leaves(r_whole.params), jax.tree.leaves(r_d0.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, rtol=0
+        )
+    # Hierarchical comm accounting: (W+1)·|θ| — more waves, more partial
+    # uplinks; never C× client deltas.
+    assert r_d0.comm_mb_per_round > r_whole.comm_mb_per_round
+    assert r_d0.comm_mb_per_round == pytest.approx(
+        r_whole.comm_mb_per_round * 5 / 2
+    )
+
+
+def test_streamed_resume_replays_identically(tmp_path):
+    """Crash/resume determinism end-to-end: rounds 0..3 straight equal
+    rounds 0..1 + restore + rounds 2..3 — cohort draws and round keys
+    are both stateless in the round index."""
+    from qfedx_tpu.run.checkpoint import Checkpointer
+
+    cx, cy, cm = _data(seed=4)
+    tx, ty = _test_set()
+    model = _model()
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.1, optimizer="sgd",
+    )
+    reg = ArrayRegistry(cx, cy, cm)
+    mesh = client_mesh(num_devices=4)
+    kw = dict(
+        cohort_size=8, wave_size=4, seed=11, eval_every=5, mesh=mesh,
+    )
+    straight = train_federated_streamed(
+        model, cfg, reg, tx, ty, num_rounds=4, **kw
+    )
+    ck = Checkpointer(tmp_path / "ck", every=2)
+    train_federated_streamed(
+        model, cfg, reg, tx, ty, num_rounds=2, checkpointer=ck, **kw
+    )
+    resumed = train_federated_streamed(
+        model, cfg, reg, tx, ty, num_rounds=4,
+        checkpointer=Checkpointer(tmp_path / "ck", every=2), **kw
+    )
+    for a, b in zip(
+        jax.tree.leaves(straight.params), jax.tree.leaves(resumed.params)
+    ):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_streamed_dp_accountant_sees_global_cohort():
+    """Client-mode DP under registry sampling: the accountant's q is
+    client_fraction · cohort/registry (cohort subsampling is real
+    amplification over the registry population) — ε must come out LOWER
+    than a cohort-equals-registry run of the same length."""
+    cx, cy, cm = _data(C=32, seed=6)
+    tx, ty = _test_set()
+    model = _model()
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.1,
+        client_fraction=0.5,
+        dp=DPConfig(clip_norm=1.0, noise_multiplier=1.0),
+    )
+    reg = ArrayRegistry(cx, cy, cm)
+    mesh = client_mesh(num_devices=4)
+    sub = train_federated_streamed(
+        model, cfg, reg, tx, ty, cohort_size=8, wave_size=8,
+        num_rounds=2, seed=1, eval_every=3, mesh=mesh,
+    )
+    full = train_federated_streamed(
+        model, cfg, reg, tx, ty, cohort_size=32, wave_size=8,
+        num_rounds=2, seed=1, eval_every=3, mesh=mesh,
+    )
+    assert len(sub.epsilons) == len(full.epsilons) == 2
+    assert sub.epsilons[-1] < full.epsilons[-1]
+
+
+def test_streamed_hier_off_requires_single_wave(monkeypatch):
+    cx, cy, cm = _data()
+    tx, ty = _test_set()
+    monkeypatch.setenv("QFEDX_HIER", "off")
+    with pytest.raises(ValueError, match="QFEDX_HIER"):
+        train_federated_streamed(
+            _model(), FedConfig(local_epochs=1, batch_size=4),
+            ArrayRegistry(cx, cy, cm), tx, ty,
+            cohort_size=16, wave_size=4, num_rounds=1,
+        )
+
+
+def test_h2d_overlaps_dispatch_in_trace(monkeypatch):
+    """The acceptance-criterion trace shape: with prefetch on, an
+    ingest.h2d span recorded by the uploader thread STARTS inside the
+    round.dispatch span. Deterministic via queue semantics at depth 1:
+    wave 2's upload cannot begin until wave 0 is consumed (inside the
+    dispatch), and must finish before wave 2 dispatches (also inside)."""
+    monkeypatch.setenv("QFEDX_TRACE", "1")
+    obs.reset()
+    cx, cy, cm = _data()
+    tx, ty = _test_set()
+    model = _model()
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1)
+    train_federated_streamed(
+        model, cfg, ArrayRegistry(cx, cy, cm), tx, ty,
+        cohort_size=16, wave_size=4, num_rounds=1, seed=0, eval_every=2,
+        mesh=client_mesh(num_devices=4), stream_depth=1,
+    )
+    spans = obs.registry().spans
+    dispatch = [s for s in spans if s.name == "round.dispatch"]
+    h2d = [s for s in spans if s.name == "ingest.h2d"]
+    assert len(dispatch) == 1 and len(h2d) == 4
+    assert {s.meta["wave"] for s in h2d} == {0, 1, 2, 3}
+    assert all(s.tname == "qfedx-ingest" for s in h2d)
+    d = dispatch[0]
+    inside = [s for s in h2d if d.t0 < s.t0 < d.t1]
+    assert inside, "no ingest.h2d span started inside round.dispatch"
+    # queue depth gauge was exercised
+    assert "ingest.queue_depth" in obs.registry().gauges
