@@ -40,6 +40,14 @@ Sections in ``bench_details.json`` (beyond the headline):
   pipeline lever measured through the REAL trainer (in-scan eval +
   per-round JSONL host work) with QFEDX_PIPELINE on vs 0 — the raw
   fed16q rows cannot see the host work the pipeline overlaps.
+- ``fed16q_bf16_guards_off``: the r11 fault-tolerance lever — the same
+  composed row with QFEDX_GUARDS=off (pre-r11 program: no non-finite
+  quarantine, no survivor machinery), so the guards' overhead stays
+  measured head-to-head like the fold/fuse/pipeline levers.
+- ``fault_tolerance``: accuracy under injected client churn — the
+  dropout_rate → accuracy degradation curve at 0/5/20% casualties per
+  round (half drops, half NaN updates; utils/faults), streamed trainer;
+  ``vs_prev`` tracks the 20% point.
 - ``time_to_target`` / ``time_to_target_20q``: wall-clock to target
   accuracy, flagship 8q config and the TRUE 20-qubit config-5 width
   (VERDICT r04 missing 1: 20q had been timed but never trained).
@@ -616,6 +624,57 @@ def _bench_fed_streamed(jax, cohort=4096, wave=256, num_rounds=3):
     return out
 
 
+def _bench_fault_tolerance(jax, cohort=128, wave=64, num_rounds=6):
+    """Dropout-rate → accuracy degradation curve (r11): the streamed
+    trainer under injected client casualties at 0 / 5 / 20% per round
+    (half drops, half NaN-poisoned updates — both recovery paths), same
+    registry/config family as the fed_streamed row. The 0% run doubles
+    as the guards-on baseline; the curve says how much accuracy the
+    dropout-resilient aggregation actually preserves as churn grows —
+    the number the million-client north star lives on. vs_prev tracks
+    the 20% point."""
+    from qfedx_tpu.data.stream import SyntheticRegistry
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.fed.round import client_mesh
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+    from qfedx_tpu.run.trainer import train_federated_streamed
+    from qfedx_tpu.utils.faults import FaultPlan
+
+    registry = SyntheticRegistry(1 << 18, samples=8, n_features=8, seed=2)
+    model = make_vqc_classifier(n_qubits=8, n_layers=3, num_classes=2)
+    cfg = FedConfig(
+        local_epochs=1, batch_size=8, learning_rate=0.1,
+        optimizer="adam", secure_agg=True, secure_agg_mode="ring",
+    )
+    mesh = client_mesh(num_devices=1)
+    ex, ey, _ = registry.batch(np.arange((1 << 18) - 32, 1 << 18))
+    tx, ty = ex.reshape(-1, 8), ey.reshape(-1)
+
+    out = {
+        "cohort": cohort, "wave_size": wave, "rounds": num_rounds,
+        "mix": "rate/2 drops + rate/2 nan per round",
+    }
+    for rate in (0.0, 0.05, 0.20):
+        plan = None
+        if rate > 0:
+            plan = FaultPlan(seed=11, rules=[
+                {"site": "client.compute", "kind": "drop", "rate": rate / 2},
+                {"site": "client.compute", "kind": "nan", "rate": rate / 2},
+            ])
+        res = train_federated_streamed(
+            model, cfg, registry, tx, ty, cohort_size=cohort,
+            wave_size=wave, num_rounds=num_rounds, seed=6, mesh=mesh,
+            eval_every=num_rounds, fault_plan=plan,
+        )
+        key = f"acc_rate_{int(rate * 100)}pct"
+        out[key] = round(float(res.accuracies[-1]), 4)
+        if rate > 0:
+            out[f"degradation_{int(rate * 100)}pct"] = round(
+                out["acc_rate_0pct"] - out[key], 4
+            )
+    return out
+
+
 def _bench_fusion_hlo(jax):
     """Per-step STATE-SIZED emitted-op counts with the fusion pass on vs
     off — the floor-reduction claim measured in ops, not asserted (ISSUE
@@ -995,12 +1054,34 @@ def main():
             / fed16_bf16_pipeline_off["client_rounds_per_s"],
             3,
         )
+    # The r11 guards lever: same composed row with the fault-tolerance
+    # machinery compiled OUT (QFEDX_GUARDS=off builds the pre-r11
+    # program) — the overhead of quarantine isfinite/where ops plus the
+    # casualty counters, measured head-to-head like the fold/fuse/
+    # pipeline levers above.
+    fed16_bf16_guards_off = safe(
+        lambda j: _with_env(
+            {"QFEDX_DTYPE": "bf16", "QFEDX_GUARDS": "off"},
+            _bench_fed16q, j,
+        )
+    )
+    if (
+        "client_rounds_per_s" in fed16_bf16
+        and "client_rounds_per_s" in fed16_bf16_guards_off
+    ):
+        fed16_bf16["guards_overhead_vs_off"] = round(
+            fed16_bf16_guards_off["client_rounds_per_s"]
+            / fed16_bf16["client_rounds_per_s"],
+            3,
+        )
     fed256 = safe(_bench_fed256)
     # r10: cohort size unbound from HBM — 4096 clients/round through
     # 256-client streamed waves on one chip (hierarchical partial/apply
     # + background H2D staging; the resident fed256 row stays as the
     # one-wave anchor).
     fed_streamed = safe(_bench_fed_streamed)
+    # r11: accuracy under injected client churn (0/5/20% casualties).
+    fault_tolerance = safe(_bench_fault_tolerance)
     fusion_hlo = safe(_bench_fusion_hlo)
     ttt = safe(_bench_time_to_target)
     ttt20 = safe(
@@ -1062,6 +1143,12 @@ def main():
                 "fed_streamed_client_rounds_per_s",
                 fed_streamed.get("client_rounds_per_s"),
                 (prev.get("fed_streamed") or {}).get("client_rounds_per_s"),
+                True,
+            )
+            delta(
+                "fault_tolerance_acc_20pct",
+                fault_tolerance.get("acc_rate_20pct"),
+                (prev.get("fault_tolerance") or {}).get("acc_rate_20pct"),
                 True,
             )
             delta("compute_bound_fwd_grad_s", compute.get("fwd_grad_s"),
@@ -1134,8 +1221,10 @@ def main():
         "fed16q_bf16_fuse_off": fed16_bf16_fuse_off,
         "fed16q_bf16_pipeline": fed16_bf16_pipeline,
         "fed16q_bf16_pipeline_off": fed16_bf16_pipeline_off,
+        "fed16q_bf16_guards_off": fed16_bf16_guards_off,
         "fed256": fed256,
         "fed_streamed": fed_streamed,
+        "fault_tolerance": fault_tolerance,
         "fusion_hlo": fusion_hlo,
         "time_to_target": ttt,
         "time_to_target_20q": ttt20,
@@ -1196,6 +1285,9 @@ def main():
                     "bf16_trainer_pipeline_off": fed16_bf16_pipeline_off.get(
                         "client_rounds_per_s"
                     ),
+                    "bf16_guards_off": fed16_bf16_guards_off.get(
+                        "client_rounds_per_s"
+                    ),
                 },
                 "fed256": {
                     "client_rounds_per_s": fed256.get("client_rounds_per_s"),
@@ -1212,6 +1304,16 @@ def main():
                 }
                 if "error" not in fed_streamed
                 else {"error": fed_streamed["error"][:80]},
+                # r11: the dropout_rate → accuracy degradation curve
+                # (0/5/20% casualties; vs_prev tracks the 20% point).
+                "fault_tolerance": {
+                    k: fault_tolerance.get(k)
+                    for k in (
+                        "acc_rate_0pct", "acc_rate_5pct", "acc_rate_20pct",
+                    )
+                }
+                if "error" not in fault_tolerance
+                else {"error": fault_tolerance["error"][:80]},
                 "fusion_hlo_n18": fusion_hlo.get("n18")
                 if isinstance(fusion_hlo, dict)
                 else None,

@@ -41,6 +41,21 @@ import numpy as np
 
 from qfedx_tpu import obs
 from qfedx_tpu.utils import pins
+from qfedx_tpu.utils.retry import RetryExhausted, retry_with_deadline
+
+
+class StreamError(RuntimeError):
+    """A wave upload failed for good (retries exhausted) or the uploader
+    thread died — delivered PROMPTLY on the consumer queue instead of
+    stranding ``__next__`` until timeout (r11 satellite). Carries the
+    ``wave`` index and the ``original`` exception (also chained as
+    ``__cause__`` when raised by the consumer)."""
+
+    def __init__(self, message: str, wave: int | None = None,
+                 original: BaseException | None = None):
+        super().__init__(message)
+        self.wave = wave
+        self.original = original
 
 
 def resolve_stream_depth(depth: int | None = None) -> int:
@@ -151,8 +166,15 @@ class WaveStream:
     ``ingest.h2d`` spans land on the uploader thread and an
     ``ingest.queue_depth`` gauge tracks staging occupancy. Depth 0
     uploads synchronously in the consumer loop (the sequential
-    reference). Uploader errors re-raise in the consumer at the wave
-    where they occurred; ``close()`` stops a partially consumed stream.
+    reference). Each wave's fetch+transfer runs under the shared retry
+    policy (transient failures recover in place); a persistent failure
+    — or the uploader thread dying outright — surfaces in the consumer
+    as a typed ``StreamError`` carrying the wave index and original
+    error, promptly (bounded get + liveness check, never a silent
+    hang). ``close()`` stops a partially consumed stream and must not
+    hang even after a failed uploader. ``fault_plan``/``round_idx``
+    (r11): consult a ``utils.faults.FaultPlan`` for injected
+    registry/H2D errors and per-client data poisoning.
     """
 
     _DONE = object()
@@ -165,6 +187,8 @@ class WaveStream:
         wave_size: int,
         depth: int | None = None,
         axis: str = "clients",
+        fault_plan=None,
+        round_idx: int = 0,
     ):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -186,6 +210,12 @@ class WaveStream:
         self._wave_size = int(wave_size)
         self.num_waves = len(cohort_ids) // int(wave_size)
         self._sharding = NamedSharding(mesh, P(axis))
+        # Fault harness (r11): with a plan, transient registry/H2D
+        # failures are injected into (and recovered by) the retried
+        # fetch below, and poisoned clients' features go non-finite so
+        # the round program's quarantine is exercised organically.
+        self._plan = fault_plan
+        self._round_idx = int(round_idx)
         self.depth = resolve_stream_depth(depth)
         self._next_wave = 0
         self._closed = False
@@ -199,19 +229,48 @@ class WaveStream:
             self._thread.start()
 
     def _upload(self, wave: int):
-        """Host batch → sharded device arrays for one wave. device_put is
-        asynchronous — the transfer is queued, not awaited, so compute on
-        in-flight waves and H2D genuinely overlap."""
+        """Host batch → sharded device arrays for one wave, with the
+        shared retry policy (utils/retry) around the fetch + transfer:
+        a transient registry or H2D failure is retried with backoff
+        before surfacing as a typed ``StreamError``. device_put is
+        asynchronous — the transfer is queued, not awaited, so compute
+        on in-flight waves and H2D genuinely overlap."""
         lo = wave * self._wave_size
         ids = self._ids[lo:lo + self._wave_size]
-        cx, cy, cmask = self._registry.batch(ids)
-        with obs.span("ingest.h2d", wave=wave, clients=len(ids)):
-            put = self._jax.device_put
-            out = (
-                put(np.ascontiguousarray(cx), self._sharding),
-                put(np.ascontiguousarray(cy), self._sharding),
-                put(np.asarray(cmask, dtype=np.float32), self._sharding),
+
+        def attempt(k: int):
+            if self._plan is not None:
+                self._plan.check(
+                    "registry.fetch", self._round_idx, wave, attempt=k
+                )
+            cx, cy, cmask = self._registry.batch(ids)
+            if self._plan is not None:
+                pois = self._plan.poison(self._round_idx, ids)
+                if not np.all(pois == 1.0):
+                    cx = np.asarray(cx) * pois.reshape(
+                        (len(ids),) + (1,) * (np.ndim(cx) - 1)
+                    )
+                self._plan.check(
+                    "ingest.h2d", self._round_idx, wave, attempt=k
+                )
+            with obs.span("ingest.h2d", wave=wave, clients=len(ids)):
+                put = self._jax.device_put
+                return (
+                    put(np.ascontiguousarray(cx), self._sharding),
+                    put(np.ascontiguousarray(cy), self._sharding),
+                    put(np.asarray(cmask, dtype=np.float32), self._sharding),
+                )
+
+        try:
+            out = retry_with_deadline(
+                attempt, attempts=3, base_delay_s=0.05, max_delay_s=0.5,
+                deadline_s=30.0, describe=f"wave {wave} upload",
             )
+        except RetryExhausted as exc:
+            raise StreamError(
+                f"wave {wave} upload failed: {exc}", wave=wave,
+                original=exc.last,
+            ) from exc.last
         return lo, out
 
     def _put(self, item) -> bool:
@@ -228,6 +287,7 @@ class WaveStream:
         return False
 
     def _uploader(self) -> None:
+        wave = 0
         try:
             for wave in range(self.num_waves):
                 if self._closed:
@@ -237,6 +297,14 @@ class WaveStream:
                     return
                 obs.gauge("ingest.queue_depth", self._queue.qsize())
         except BaseException as exc:  # noqa: BLE001 — re-raised by consumer
+            # ALWAYS a typed StreamError on the queue (r11 satellite):
+            # the consumer learns which wave died and why, promptly,
+            # instead of timing out against a dead thread.
+            if not isinstance(exc, StreamError):
+                exc = StreamError(
+                    f"wave {wave} upload failed: {exc!r}", wave=wave,
+                    original=exc,
+                )
             self._put(exc)
         else:
             self._put(self._DONE)
@@ -250,7 +318,26 @@ class WaveStream:
         if self._queue is None:
             item = self._upload(self._next_wave)
         else:
-            item = self._queue.get()
+            # Bounded get + thread-liveness check: a killed uploader
+            # (die-without-sentinel — e.g. interpreter teardown, or a
+            # bug in the error path itself) must not strand the trainer
+            # in an unbounded queue.get.
+            while True:
+                try:
+                    item = self._queue.get(timeout=0.2)
+                    break
+                except queue.Empty:
+                    if self._thread is not None and not self._thread.is_alive():
+                        try:  # a final racing put may have landed
+                            item = self._queue.get_nowait()
+                            break
+                        except queue.Empty:
+                            self._closed = True
+                            raise StreamError(
+                                "uploader thread died without delivering "
+                                f"wave {self._next_wave}",
+                                wave=self._next_wave,
+                            ) from None
             obs.gauge("ingest.queue_depth", self._queue.qsize())
             if item is self._DONE:
                 raise StopIteration

@@ -69,6 +69,18 @@ class RDPAccountant:
     One ``step(q, sigma)`` per round (q = client sampling fraction,
     σ = noise multiplier); ``epsilon(δ)`` at any time gives the current
     guarantee — the roadmap's "log ε after each round" (ROADMAP.md:58).
+
+    Dropout invariance (r11): q is a property of the mechanism's
+    SAMPLING distribution, decided before any client runs — callers
+    must derive it from the sampled cohort (registry draw ×
+    client_fraction), never from the survivor set. Shrinking q because
+    clients died mid-round would claim subsampling amplification the
+    mechanism never performed (the casualty WAS selected; its absence
+    is an outcome, not a sampling event), under-reporting ε. Charging
+    the full sampled cohort is exactly conservative under dropout, and
+    a skipped round (min_participation) is still charged — the noise
+    draw existed even if θ ignored it. Pinned dropout-invariant in
+    tests/test_faults.py.
     """
 
     orders: np.ndarray = field(default_factory=lambda: DEFAULT_ORDERS.copy())

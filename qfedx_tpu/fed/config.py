@@ -70,6 +70,13 @@ class FedConfig:
     # False with dp configured is rejected — the privacy guarantee must
     # not hinge on a config default (see __post_init__).
     dp_uniform_weights: bool = True
+    # Graceful-degradation floor (r11): if fewer than this FRACTION of
+    # the round's cohort survives (sampled ∧ not dropped ∧ finite
+    # update), the apply step becomes the identity — the round is
+    # skipped and logged (stats.applied = 0) instead of averaging a
+    # nearly-empty, possibly mask-dust-dominated sum into θ. 0 (the
+    # default) disables the floor and keeps the pre-r11 program exactly.
+    min_participation: float = 0.0
 
     def __post_init__(self):
         if self.algorithm not in ("fedavg", "fedprox"):
@@ -82,6 +89,11 @@ class FedConfig:
             raise ValueError(f"unknown secure_agg_mode {self.secure_agg_mode!r}")
         if self.secure_agg_neighbors < 1:
             raise ValueError("secure_agg_neighbors must be ≥ 1")
+        if not (0.0 <= self.min_participation <= 1.0):
+            raise ValueError(
+                f"min_participation={self.min_participation} must be a "
+                "fraction in [0, 1]"
+            )
         if (
             self.dp is not None
             and self.dp.mode == "example"

@@ -49,7 +49,11 @@ from qfedx_tpu.utils.compat import shard_map
 class RoundStats(NamedTuple):
     mean_loss: jax.Array  # participation-weighted mean local loss
     total_weight: jax.Array  # Σ aggregation weights (0 ⇒ round was a no-op)
-    num_participants: jax.Array
+    num_participants: jax.Array  # sampled ∧ surviving ∧ finite contributors
+    # r11 fault-tolerance ledger (all zeros on the guards-off program):
+    rejected_updates: jax.Array = np.float32(0.0)  # non-finite Δθ quarantined
+    dropped_clients: jax.Array = np.float32(0.0)  # sampled but dropped
+    applied: jax.Array = np.float32(1.0)  # 0 ⇒ round skipped (min_participation)
 
 
 class RoundPartial(NamedTuple):
@@ -70,6 +74,30 @@ class RoundPartial(NamedTuple):
     weight_sum: jax.Array
     loss_sum: jax.Array  # Σ weight·loss (mean = loss_sum / weight_sum)
     num_participants: jax.Array
+    # Casualty counts (additive across waves like every other field;
+    # zeros on the guards-off program):
+    rejected_updates: jax.Array = np.float32(0.0)
+    dropped_clients: jax.Array = np.float32(0.0)
+
+
+def guards_enabled() -> bool:
+    """Build the fault-tolerant round program (r11)?
+
+    ``QFEDX_GUARDS`` (``0``/``off``/``1``/``on``, default ON) pins at
+    BUILD time whether the round program carries the robustness
+    machinery: a per-client *survivor mask* input (mid-round dropouts:
+    the casualty's weighted contribution and its secure-agg masks are
+    excluded — the in-program realization of the server's
+    mask-recovery subtraction, docs/ROBUSTNESS.md), the non-finite
+    quarantine (an ``isfinite`` all-reduce over each client's Δθ; rejected
+    updates are zeroed, counted, and never reach θ), and the casualty
+    counters in ``RoundStats``/``RoundPartial``. Off builds the exact
+    r10 program — the bit-parity and bench lever
+    (``fed16q_bf16_guards_off``); with guards on and zero casualties
+    the θ trajectory is pinned identical to the guards-off program in
+    tests/test_robust_round.py.
+    """
+    return pins.bool_pin("QFEDX_GUARDS", True)
 
 
 def hier_enabled() -> bool:
@@ -144,6 +172,8 @@ def _make_per_device_partial(
     cohort_clients: int,
     axis: str,
     axis_size: int,
+    guards: bool = False,
+    with_survivors: bool = False,
 ):
     """Shared per-device body of the flat AND hierarchical round programs.
 
@@ -156,6 +186,23 @@ def _make_per_device_partial(
     (the hierarchy-wide cancellation the r10 tentpole requires). A flat
     round is the special case wave == cohort, wave_base == 0 — one code
     path, parity by construction.
+
+    ``guards=True`` (r11) builds the fault-tolerant body: it takes a
+    trailing ``survivors`` [cohort] 0/1 input and (1) restricts the
+    EFFECTIVE participation set to sampled ∧ surviving — weights AND
+    secure-agg pair graphs are drawn over it, so a dropped client's
+    unmatched ring masks never enter the sum (arithmetically the
+    server's regenerate-and-subtract recovery, and bit-exact to the
+    same round run over the survivor-only participation set — pinned in
+    tests/test_robust_round.py); because the survivor set spans the
+    COHORT like participation does, recovery composes with waves and
+    with DP unchanged. (2) Quarantines non-finite updates: each
+    client's Δθ/loss is isfinite-reduced AFTER local training; a
+    rejected client's delta and loss are zeroed, its weight goes to 0,
+    and — its own masks being deterministic regenerations, not part of
+    the corrupted upload — its secure-agg masks STAY in the sum so ring
+    cancellation over the effective set still holds. Rejections and
+    dropouts are counted into the partial.
     """
     local_update = make_local_update(model, cfg)
     folded = fold_clients_enabled(model, cfg)
@@ -175,7 +222,7 @@ def _make_per_device_partial(
     # sampling/local_update/dp/secure-agg/aggregate, and ``obs.span``
     # (QFEDX_TRACE-gated, trace-time only — this function runs under
     # jit) records where TRACE-BUILD wall goes, once per compile.
-    def per_device_partial(params, cx, cy, cmask, wave_base, round_key):
+    def _body(params, cx, cy, cmask, wave_base, round_key, survivors):
         # Local block shapes: cx [block, S, ...]; params replicated.
         # Client ids are COHORT positions: wave_base offsets this wave's
         # block into the round's global cohort.
@@ -185,15 +232,44 @@ def _make_per_device_partial(
             part = participation_mask(
                 round_key, num_clients, cfg.client_fraction
             )
+            # The EFFECTIVE participation set: sampled ∧ surviving. Both
+            # weights and secure-agg pair graphs run over it, so a
+            # dropped client's unmatched ring masks never enter the sum
+            # — and a round with dropouts IS the survivor-only round,
+            # bit for bit (docs/ROBUSTNESS.md on why this equals the
+            # server's regenerate-and-subtract recovery). survivors is
+            # None on the no-casualty program variant (the builders
+            # compile it separately so a fault-free run never carries
+            # the survivor input or its multiplies).
+            eff = part * survivors if survivors is not None else part
 
         train_key = jax.random.fold_in(round_key, 0x7A41)
         dp_key = jax.random.fold_in(round_key, 0xD9)
         sa_key = jax.random.fold_in(round_key, 0x5EC)
 
         def postprocess(cid, delta, n, loss):
-            """Privacy/masking/weighting of ONE client's finished update —
-            shared verbatim between the folded and vmap paths (always
-            vmapped: param-sized trees, no slab states)."""
+            """Quarantine/privacy/masking/weighting of ONE client's
+            finished update — shared verbatim between the folded and
+            vmap paths (always vmapped: param-sized trees, no slab
+            states)."""
+            if guards:
+                # Non-finite quarantine BEFORE anything consumes Δθ: a
+                # NaN/Inf update is zeroed here (where, not multiply —
+                # NaN·0 is NaN), its weight goes to 0 below, and its
+                # loss is excluded; DP clip/noise then operate on the
+                # zeroed tree so nothing non-finite can propagate.
+                with jax.named_scope("quarantine"):
+                    fin = jnp.isfinite(loss)
+                    for leaf in jax.tree.leaves(delta):
+                        fin = jnp.logical_and(
+                            fin, jnp.all(jnp.isfinite(leaf))
+                        )
+                    delta = jax.tree.map(
+                        lambda d: jnp.where(fin, d, jnp.zeros_like(d)),
+                        delta,
+                    )
+                    loss = jnp.where(fin, loss, jnp.zeros_like(loss))
+                    finf = fin.astype(jnp.float32)
             if cfg.dp is not None:
                 if cfg.dp.mode == "client":
                     with jax.named_scope("dp_clip_noise"):
@@ -210,21 +286,30 @@ def _make_per_device_partial(
                 weight = jnp.minimum(n, 1.0)
             else:
                 weight = n
-            weight = weight * part[cid]
+            weight = weight * eff[cid]
+            if guards:
+                weight = weight * finf
             contrib = trees.tree_scale(delta, weight)
             if cfg.secure_agg:
                 with jax.named_scope("secure_agg_mask"):
+                    # Pair graph over ``eff``: a QUARANTINED client's
+                    # masks stay in the sum (finf does not gate them) —
+                    # they are deterministic PRG regenerations, not part
+                    # of the corrupted upload, so including them keeps
+                    # ring cancellation exact while its data term is 0.
                     if cfg.secure_agg_mode == "ring":
                         mask = ring_mask(
-                            sa_key, cid, num_clients, delta, part,
+                            sa_key, cid, num_clients, delta, eff,
                             cfg.secure_agg_scale, cfg.secure_agg_neighbors,
                         )
                     else:
                         mask = client_mask(
-                            sa_key, cid, num_clients, delta, part,
+                            sa_key, cid, num_clients, delta, eff,
                             cfg.secure_agg_scale,
                         )
                     contrib = trees.tree_add(contrib, mask)
+            if guards:
+                return contrib, weight, loss, finf
             return contrib, weight, loss
 
         if folded:
@@ -243,7 +328,7 @@ def _make_per_device_partial(
             with obs.span("fed.trace.postprocess"), jax.named_scope(
                 "privacy_postprocess"
             ):
-                contribs, weights, losses = jax.vmap(postprocess)(
+                outs = jax.vmap(postprocess)(
                     client_ids, deltas, ns, losses_c
                 )
         else:
@@ -259,9 +344,11 @@ def _make_per_device_partial(
             with obs.span(
                 "fed.trace.local_update", path="vmap"
             ), jax.named_scope("local_update"):
-                contribs, weights, losses = jax.vmap(run_client)(
-                    client_ids, cx, cy, cmask
-                )
+                outs = jax.vmap(run_client)(client_ids, cx, cy, cmask)
+        if guards:
+            contribs, weights, losses, fins = outs
+        else:
+            contribs, weights, losses = outs
 
         # Reduce the local client block, then all-reduce across chips —
         # the per-chip partial aggregate of the hierarchy.
@@ -270,31 +357,93 @@ def _make_per_device_partial(
             update_sum = jax.lax.psum(block_sum, axis)
             weight_sum = jax.lax.psum(jnp.sum(weights), axis)
             loss_sum = jax.lax.psum(jnp.sum(weights * losses), axis)
-            n_part = jax.lax.psum(jnp.sum(part[client_ids]), axis)
+            if guards:
+                eff_ids = eff[client_ids]
+                n_part = jax.lax.psum(jnp.sum(eff_ids * fins), axis)
+                rejected = jax.lax.psum(
+                    jnp.sum(eff_ids * (1.0 - fins)), axis
+                )
+                dropped = (
+                    jax.lax.psum(
+                        jnp.sum(part[client_ids] - eff_ids), axis
+                    )
+                    if survivors is not None
+                    else jnp.zeros((), jnp.float32)
+                )
+            else:
+                n_part = jax.lax.psum(jnp.sum(part[client_ids]), axis)
+                rejected = jnp.zeros((), jnp.float32)
+                dropped = jnp.zeros((), jnp.float32)
         return RoundPartial(
             update_sum=update_sum,
             weight_sum=weight_sum,
             loss_sum=loss_sum,
             num_participants=n_part,
+            rejected_updates=rejected,
+            dropped_clients=dropped,
         )
+
+    if guards and with_survivors:
+
+        def per_device_partial(
+            params, cx, cy, cmask, wave_base, round_key, survivors
+        ):
+            return _body(
+                params, cx, cy, cmask, wave_base, round_key, survivors
+            )
+
+    else:
+
+        def per_device_partial(params, cx, cy, cmask, wave_base, round_key):
+            return _body(
+                params, cx, cy, cmask, wave_base, round_key, None
+            )
 
     return per_device_partial
 
 
-def _finalize_partial(params, partial: RoundPartial):
+def _finalize_partial(
+    params, partial: RoundPartial, min_participants: float = 0.0
+):
     """θ_new = θ + Σ wΔ / Σ w — the hierarchy's root combine, shared
     verbatim between the flat round (inline) and ``make_apply_partial``
-    (its own dispatch after the last wave)."""
+    (its own dispatch after the last wave).
+
+    ``min_participants`` > 0 is the graceful-degradation floor (r11,
+    ``FedConfig.min_participation`` × cohort): when fewer clients
+    survive the round — dropouts plus quarantined updates — the apply
+    step becomes the IDENTITY (θ passes through bitwise, a
+    ``jnp.where`` per leaf; ``stats.applied`` reports 0) so one
+    catastrophic round degrades to a skipped round instead of averaging
+    a nearly-empty — or, under secure-agg, mask-dust-dominated — sum
+    into θ. At the default 0 the predicate (and its ops) are absent:
+    the program is the pre-r11 finalize exactly.
+    """
     denom = jnp.maximum(partial.weight_sum, 1e-12)
-    new_params = jax.tree.map(
-        lambda p, u: (p + u / denom).astype(p.dtype),
-        params,
-        partial.update_sum,
-    )
+    if min_participants > 0:
+        ok = partial.num_participants >= jnp.float32(min_participants)
+        new_params = jax.tree.map(
+            lambda p, u: jnp.where(
+                ok, (p + u / denom).astype(p.dtype), p
+            ),
+            params,
+            partial.update_sum,
+        )
+        applied = ok.astype(jnp.float32)
+    else:
+        new_params = jax.tree.map(
+            lambda p, u: (p + u / denom).astype(p.dtype),
+            params,
+            partial.update_sum,
+        )
+        applied = jnp.ones((), jnp.float32)
     stats = RoundStats(
         mean_loss=partial.loss_sum / denom,
         total_weight=partial.weight_sum,
         num_participants=partial.num_participants,
+        rejected_updates=partial.rejected_updates,
+        dropped_clients=partial.dropped_clients,
+        applied=applied,
     )
     return new_params, stats
 
@@ -318,24 +467,80 @@ def make_fed_round(
     commonly reuse a params buffer after a round call, which donation
     would invalidate on accelerator backends. The trainer opts in via
     ``donate_enabled()`` (the QFEDX_DONATE pin).
+
+    With guards on (``guards_enabled()``, the default) the returned
+    ``round_fn`` additionally accepts an optional trailing
+    ``survivors`` [num_clients] 0/1 array (default all-ones): mid-round
+    casualties marked 0 are excluded from the aggregate AND the
+    secure-agg pair graph (dropout-resilient aggregation, r11 —
+    see ``_make_per_device_partial``). Guards off builds the exact
+    pre-r11 program with no survivors input — the bit-parity lever.
     """
-    per_partial = _make_per_device_partial(
-        model, cfg, num_clients, num_clients, axis, mesh.shape[axis]
-    )
+    guards = guards_enabled()
+    min_count = cfg.min_participation * num_clients
+    donate_argnums = (0,) if donate else ()
 
-    def per_device(params, cx, cy, cmask, round_key):
-        partial = per_partial(params, cx, cy, cmask, 0, round_key)
-        with jax.named_scope("aggregate"):
-            return _finalize_partial(params, partial)
+    def build(with_survivors: bool):
+        per_partial = _make_per_device_partial(
+            model, cfg, num_clients, num_clients, axis, mesh.shape[axis],
+            guards=guards, with_survivors=with_survivors,
+        )
+        if with_survivors:
 
-    sharded = shard_map(
-        per_device,
-        mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), P(axis), P()),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+            def per_device(params, cx, cy, cmask, round_key, survivors):
+                partial = per_partial(
+                    params, cx, cy, cmask, 0, round_key, survivors
+                )
+                with jax.named_scope("aggregate"):
+                    return _finalize_partial(params, partial, min_count)
+
+            specs = (P(), P(axis), P(axis), P(axis), P(), P())
+        else:
+
+            def per_device(params, cx, cy, cmask, round_key):
+                partial = per_partial(params, cx, cy, cmask, 0, round_key)
+                with jax.named_scope("aggregate"):
+                    return _finalize_partial(params, partial, min_count)
+
+            specs = (P(), P(axis), P(axis), P(axis), P())
+        sharded = shard_map(
+            per_device, mesh=mesh, in_specs=specs,
+            out_specs=(P(), P()), check_vma=False,
+        )
+        return jax.jit(sharded, donate_argnums=donate_argnums)
+
+    jitted = build(with_survivors=False)
+    if not guards:
+        # Uniform signature either way: survivors=None is accepted (and
+        # ignored — there is nothing to apply) so call sites need no
+        # guards-conditional branching; an ACTUAL survivor mask against
+        # the unguarded program is a loud error, not a silent drop.
+        def round_fn(params, cx, cy, cmask, round_key, survivors=None):
+            if survivors is not None:
+                raise ValueError(
+                    "survivors requires the guarded round program "
+                    "(QFEDX_GUARDS=off built the pre-r11 program, which "
+                    "has no survivor input)"
+                )
+            return jitted(params, cx, cy, cmask, round_key)
+
+        return round_fn
+    # Two programs, one seam: the no-survivors variant carries the
+    # quarantine but no survivor input (every fault-free caller — and
+    # every pre-r11 call site — pays for nothing new), while the
+    # survivors variant traces/compiles lazily on the first call that
+    # actually has casualties.
+    jitted_s = build(with_survivors=True)
+
+    def round_fn(params, cx, cy, cmask, round_key, survivors=None):
+        if survivors is None:
+            return jitted(params, cx, cy, cmask, round_key)
+        return jitted_s(
+            params, cx, cy, cmask, round_key,
+            jnp.asarray(survivors, jnp.float32),
+        )
+
+    return round_fn
 
 
 def make_fed_round_partial(
@@ -360,19 +565,64 @@ def make_fed_round_partial(
     the same W·C clients up to summation order (pinned, with tolerance,
     in tests/test_hier.py; one wave is bit-exact). No donation: θ must
     survive every wave of the round until ``make_apply_partial``.
+
+    With guards on the returned ``partial_fn`` accepts an optional
+    trailing ``survivors`` [cohort] 0/1 array (default all-ones); the
+    survivor set — like participation — spans the COHORT and is passed
+    identically to every wave, so dropout recovery composes with the
+    hierarchy: a casualty's ring partners in OTHER waves draw the same
+    effective pair graph and cancellation survives the wave split
+    (pinned in tests/test_robust_round.py).
     """
     cohort = wave_clients if cohort_clients is None else cohort_clients
-    per_partial = _make_per_device_partial(
-        model, cfg, wave_clients, cohort, axis, mesh.shape[axis]
-    )
-    sharded = shard_map(
-        per_partial,
-        mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), P(axis), P(), P()),
-        out_specs=P(),
-        check_vma=False,
-    )
-    return jax.jit(sharded)
+    guards = guards_enabled()
+
+    def build(with_survivors: bool):
+        per_partial = _make_per_device_partial(
+            model, cfg, wave_clients, cohort, axis, mesh.shape[axis],
+            guards=guards, with_survivors=with_survivors,
+        )
+        specs = (P(), P(axis), P(axis), P(axis), P(), P())
+        if with_survivors:
+            specs = specs + (P(),)
+        sharded = shard_map(
+            per_partial, mesh=mesh, in_specs=specs, out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(sharded)
+
+    jitted = build(with_survivors=False)
+    if not guards:
+        # Uniform signature (see make_fed_round): survivors=None is
+        # accepted, a real mask against the unguarded program raises.
+        def partial_fn(
+            params, cx, cy, cmask, wave_base, round_key, survivors=None
+        ):
+            if survivors is not None:
+                raise ValueError(
+                    "survivors requires the guarded round program "
+                    "(QFEDX_GUARDS=off built the pre-r11 program, which "
+                    "has no survivor input)"
+                )
+            return jitted(params, cx, cy, cmask, wave_base, round_key)
+
+        return partial_fn
+    # Same two-program seam as make_fed_round: fault-free waves run the
+    # no-survivors program; the survivors variant compiles only when a
+    # round actually has casualties.
+    jitted_s = build(with_survivors=True)
+
+    def partial_fn(
+        params, cx, cy, cmask, wave_base, round_key, survivors=None
+    ):
+        if survivors is None:
+            return jitted(params, cx, cy, cmask, wave_base, round_key)
+        return jitted_s(
+            params, cx, cy, cmask, wave_base, round_key,
+            jnp.asarray(survivors, jnp.float32),
+        )
+
+    return partial_fn
 
 
 def make_accumulate_partial(donate: bool = False):
@@ -388,16 +638,29 @@ def make_accumulate_partial(donate: bool = False):
     return jax.jit(accum, donate_argnums=(0,) if donate else ())
 
 
-def make_apply_partial():
+def make_apply_partial(
+    cfg: FedConfig | None = None, cohort_clients: int = 0
+):
     """Jitted ``apply_fn(params, partial) -> (params, stats)`` — the
     hierarchy's root: apply the cross-wave accumulated ``RoundPartial``
     to θ. Ops match the flat round's in-program finalize exactly
     (``_finalize_partial`` is shared), so a 1-wave partial + apply
-    reproduces ``make_fed_round`` bit-for-bit (tests/test_hier.py)."""
+    reproduces ``make_fed_round`` bit-for-bit (tests/test_hier.py).
+
+    Pass ``cfg`` + ``cohort_clients`` to honor
+    ``cfg.min_participation`` at the hierarchy root (the streamed
+    trainer does): with fewer than ``min_participation ·
+    cohort_clients`` surviving participants accumulated across the
+    round's waves, the apply is the identity and ``stats.applied`` is 0
+    (graceful degradation, r11). Default: no floor — the pre-r11
+    program."""
+    min_count = (
+        cfg.min_participation * cohort_clients if cfg is not None else 0.0
+    )
 
     def apply_fn(params, partial: RoundPartial):
         with jax.named_scope("aggregate"):
-            return _finalize_partial(params, partial)
+            return _finalize_partial(params, partial, min_count)
 
     return jax.jit(apply_fn)
 

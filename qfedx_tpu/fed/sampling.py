@@ -22,6 +22,16 @@ Two composable stages since r10:
    at full participation (the reference default) there is no waste at
    all. Under the r10 hierarchy the mask spans the COHORT, not the wave,
    so secure-agg pair graphs drawn from it cancel across waves.
+
+Since r11 a third, OUTCOME-side stage composes on top: the round
+program intersects this mask with a per-round *survivor mask*
+(``fed/round.py``; set by the fault harness or discovered casualties)
+into the effective participation set that weights and secure-agg pair
+graphs actually run over. The layering matters for privacy: the DP
+accountant charges the SAMPLING stages (cohort draw × participation
+fraction) and never the survivor stage — a casualty was still selected
+by the mechanism, so dropout must not shrink the accounted q
+(run/trainer.py, tests/test_faults.py).
 """
 
 from __future__ import annotations

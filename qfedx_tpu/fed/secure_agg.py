@@ -29,6 +29,21 @@ the replicated round key (``fed.sampling``), so every client computes
 every peer's membership — and its ring neighbors — locally, the
 jit-friendly stand-in for the real protocol's mask-recovery phase
 (SURVEY.md §7.3.3).
+
+Dropout recovery (r11): when a participant dies mid-round its ring
+edges are unmatched — each surviving neighbor's upload carries a PRG
+term the casualty never cancelled, and the cohort-wide sum is corrupted
+by exactly the casualty's own mask (Σ_{i∈part} m_i = 0 ⇒
+Σ_{survivors} m_i = −Σ_{dropped} m_j). Because ``pair_key`` /
+``_edge_key`` are deterministic folds of the replicated round key, the
+server can REGENERATE every dropped client's masks with zero extra
+communication and subtract the residual — the arithmetic of the real
+protocol's mask-recovery phase. ``unmatched_mask_sum`` computes that
+correction term; ``fed/round.py`` realizes the same recovery
+in-program by drawing the pair graph over the surviving participation
+set (bit-exact to a survivor-only round by construction — see
+docs/ROBUSTNESS.md for why the two forms are arithmetically the same
+cancellation, differing only in float summation order).
 """
 
 from __future__ import annotations
@@ -132,3 +147,47 @@ def ring_mask(
             lambda a, mo, mi: a + c_out * mo - c_in * mi, acc, m_out, m_in
         )
     return acc
+
+
+def unmatched_mask_sum(
+    base_key: jax.Array,
+    num_clients: int,
+    template,
+    participation,  # [num_clients] 0/1 — the PRE-dropout pair graph
+    survivors,  # [num_clients] 0/1 — who actually finished the round
+    scale: float = 1.0,
+    neighbors: int = 1,
+    mode: str = "ring",
+):
+    """Σ_{j: participating ∧ ¬surviving} mask_j — the server-side
+    regenerated correction for mid-round dropouts.
+
+    Survivors' uploads sum to Σ_{i∈S∩part} (wΔ)_i + Σ_{i∈S∩part} m_i,
+    and since the full pair graph cancels (Σ_{part} m = 0) the mask
+    residue equals −Σ_{dropped∩part} m_j. Every key in m_j is a
+    deterministic fold of the replicated round key (``pair_key`` /
+    ``_edge_key``), so the server regenerates each casualty's mask
+    on-device and ADDS this sum back — no communication, no reveal of
+    any surviving client's masks (only dead clients' masks are
+    reconstructed, exactly the real protocol's recovery semantics).
+    Cancellation is float-dust exact (≲1e-5 at test scales), pinned in
+    tests/test_robust_round.py against the survivor-side residue.
+    """
+    mask_fn = ring_mask if mode == "ring" else client_mask
+
+    def body(acc, j):
+        coeff = participation[j] * (1.0 - survivors[j])
+        if mode == "ring":
+            m = mask_fn(
+                base_key, j, num_clients, template, participation,
+                scale, neighbors,
+            )
+        else:
+            m = mask_fn(
+                base_key, j, num_clients, template, participation, scale
+            )
+        return jax.tree.map(lambda a, x: a + coeff * x, acc, m), None
+
+    zeros = trees.tree_zeros_like(template)
+    out, _ = jax.lax.scan(body, zeros, jnp.arange(num_clients))
+    return out

@@ -23,6 +23,13 @@ boundary), and ``wait()`` joins outstanding writes and re-raises any
 writer error. Final-round saves stay SYNCHRONOUS in the trainer
 (wait + save) so the params the run reports exist on disk before
 ``train_federated`` returns.
+
+r11: the async writer retries each save under the shared
+exponential-backoff policy (``utils/retry``) before surfacing a typed
+``CheckpointWriteError`` — a transient filesystem stall no longer
+fails the write outright — and consults the fault harness's
+``checkpoint.write`` site (``utils/faults``, QFEDX_FAULTS) so that
+recovery path is deterministically testable.
 """
 
 from __future__ import annotations
@@ -38,7 +45,26 @@ from typing import Any
 import jax
 import numpy as np
 
+from qfedx_tpu.utils import faults
 from qfedx_tpu.utils.host import is_primary
+from qfedx_tpu.utils.retry import RetryExhausted, retry_with_deadline
+
+
+class CheckpointWriteError(RuntimeError):
+    """An async checkpoint write failed for good — the shared retry
+    policy (utils/retry) exhausted its attempts (r11). Carries the
+    round index and the ``original`` root-cause error (also chained as
+    ``__cause__``), so the operator learns both WHAT is now stale on
+    disk and WHY the writes failed."""
+
+    def __init__(self, round_idx: int, original: BaseException,
+                 attempts: int):
+        super().__init__(
+            f"checkpoint write for round {round_idx} failed after "
+            f"{attempts} attempt(s): {original!r}"
+        )
+        self.round_idx = round_idx
+        self.original = original
 
 
 def _flatten(params: Any):
@@ -109,11 +135,31 @@ class Checkpointer:
                 if item is None:
                     return  # shutdown sentinel (wait() retires the thread)
                 round_idx, params = item
+
                 # The np.asarray fetch inside save() blocks until the
                 # device finishes the rounds that produced ``params``
                 # — on THIS thread, off the trainer's dispatch path.
+                # Writes run under the shared retry policy (r11): a
+                # transient filesystem stall (or an injected
+                # checkpoint.write fault) recovers in place; only an
+                # exhausted retry surfaces, as a typed error.
+                def attempt(k: int, _r=round_idx, _p=params):
+                    plan = faults.active_plan()
+                    if plan is not None:
+                        plan.check("checkpoint.write", _r, attempt=k)
+                    return self.save(_r, _p)
+
                 with obs.span("checkpoint.async_write", round=round_idx):
-                    self.save(round_idx, params)
+                    try:
+                        retry_with_deadline(
+                            attempt, attempts=3, base_delay_s=0.05,
+                            max_delay_s=0.5, deadline_s=60.0,
+                            describe=f"checkpoint write (round {round_idx})",
+                        )
+                    except RetryExhausted as exc:
+                        raise CheckpointWriteError(
+                            round_idx, exc.last, exc.attempts
+                        ) from exc.last
             except BaseException as e:  # noqa: BLE001 — surfaced by wait()
                 if self._error is None:  # keep the FIRST (root-cause) error
                     self._error = e

@@ -28,12 +28,13 @@ from qfedx_tpu.fed.evaluate import make_evaluator
 from qfedx_tpu.fed.round import (
     client_mesh,
     donate_enabled,
+    guards_enabled,
     make_fed_round,
     make_fed_rounds,
     shard_client_data,
 )
 from qfedx_tpu.models.api import Model
-from qfedx_tpu.utils import pins, trees
+from qfedx_tpu.utils import faults, pins, trees
 
 
 @dataclass
@@ -157,6 +158,10 @@ def train_federated(
     # dispatch outputs, and the pipelined loop snapshots a device-side
     # copy whenever a drain still needs θ past a donating dispatch.
     donating = donate_enabled()
+    # Read once, next to the round builds it must agree with: with
+    # guards on the round program quarantines non-finite updates and
+    # the casualty ledger below lands in metrics.jsonl.
+    guards = guards_enabled()
     round_fn = make_fed_round(
         model, cfg, mesh, num_clients=num_clients, donate=donating
     )
@@ -357,6 +362,8 @@ def train_federated(
             stats_h, accs_h = jax.device_get((stats, accs))
         t_fetch_end = time.perf_counter()
         losses = [float(l) for l in np.ravel(np.asarray(stats_h.mean_loss))]
+        rejected = np.ravel(np.asarray(stats_h.rejected_updates))
+        skipped = np.ravel(np.asarray(stats_h.applied)) < 0.5
         scan_accs = (
             None
             if accs_h is None
@@ -384,6 +391,18 @@ def train_federated(
                 "time_s": dt_per_round,
                 "chunk_rounds": chunk,
             }
+            if guards:
+                # The non-finite quarantine ledger (r11): exact counts
+                # per round, in the permanent record — the chaos tests
+                # reconcile these against the fault plan. The obs
+                # counter mirrors them when tracing is on.
+                rej_i = int(round(float(rejected[i])))
+                metrics["rejected_updates"] = rej_i
+                if rej_i:
+                    obs.counter("fed.rejected_updates", rej_i)
+                if skipped[i]:
+                    metrics["skipped"] = True
+                    obs.counter("fed.rounds_skipped")
             if accountant is not None:
                 accountant.step(
                     q=acct_q,
@@ -635,6 +654,7 @@ def train_federated_streamed(
     on_round_end: Callable[[int, dict], None] | None = None,
     checkpointer=None,
     stream_depth: int | None = None,
+    fault_plan=None,
 ) -> TrainResult:
     """Federated training over a client REGISTRY — unbounded cohorts via
     hierarchical aggregation + streamed wave ingestion (the r10 tentpole).
@@ -671,6 +691,22 @@ def train_federated_streamed(
     round (requires wave_size == cohort_size) — the parity lever.
     Restricted to host-callable models (``model.sv_size == 1``); the
     sv-sharded composition keeps the resident path.
+
+    Fault tolerance (r11): ``fault_plan`` (a ``utils.faults.FaultPlan``;
+    default: the ``QFEDX_FAULTS`` pin) injects deterministic failures at
+    the real seams — per-round client drops become the survivor mask
+    fed to every wave's partial (dropout-resilient secure aggregation,
+    fed/round), nan/inf rules poison client data so the non-finite
+    quarantine is exercised organically, and transient registry/H2D
+    errors recover inside the WaveStream's retry. The DP accountant
+    ALWAYS charges the SAMPLED cohort's q — dropouts never shrink the
+    accounted sampling rate (shrinking q would claim amplification the
+    casualties' absence does not provide; charging the full cohort is
+    conservative and keeps ε independent of who happened to die —
+    pinned in tests/test_faults.py). Per-round casualty counts
+    (``dropped_clients``, ``rejected_updates``) and skip events land in
+    metrics.jsonl; ``cfg.min_participation`` turns a catastrophic round
+    into a logged skip instead of a corrupted θ.
     """
     from qfedx_tpu.data.stream import WaveStream
     from qfedx_tpu.fed.round import (
@@ -704,6 +740,16 @@ def train_federated_streamed(
             n_dev -= 1
         mesh = client_mesh(num_devices=n_dev)
 
+    plan = faults.resolve_plan(fault_plan)
+    guards = guards_enabled()
+    if plan is not None and not guards:
+        raise ValueError(
+            "a fault plan is active (QFEDX_FAULTS / fault_plan) but "
+            "QFEDX_GUARDS=off built the unguarded round program — "
+            "injected casualties would corrupt θ instead of exercising "
+            "the recovery path"
+        )
+
     sampler = CohortSampler(
         registry_size=registry.num_clients, cohort_size=cohort_size,
         seed=seed,
@@ -714,7 +760,7 @@ def train_federated_streamed(
             cohort_clients=cohort_size,
         )
         accum_fn = make_accumulate_partial()
-        apply_fn = make_apply_partial()
+        apply_fn = make_apply_partial(cfg, cohort_size)
         round_fn = None
     else:
         partial_fn = accum_fn = apply_fn = None
@@ -787,8 +833,23 @@ def train_federated_streamed(
         t0 = time.perf_counter()
         round_key = jax.random.fold_in(round_key_base, rnd)
         cohort_ids = sampler.round_ids(rnd)
+        # The round's survivor mask, decided by the fault plan BEFORE
+        # any wave dispatches (the server learns who died; the mask is
+        # cohort-wide so every wave's pair graph agrees). None (no plan
+        # or no casualties) keeps the all-ones fast path — and the
+        # bit-parity with a plan-free run.
+        surv = None
+        if plan is not None:
+            surv_np = plan.survivors(rnd, cohort_ids)
+            if not np.all(surv_np == 1.0):
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                surv = jax.device_put(
+                    surv_np, NamedSharding(mesh, PartitionSpec())
+                )
         stream = WaveStream(
-            registry, mesh, cohort_ids, wave_size, depth=stream_depth
+            registry, mesh, cohort_ids, wave_size, depth=stream_depth,
+            fault_plan=plan, round_idx=rnd,
         )
         try:
             # Dispatch wall covers the whole wave fan-in: JAX's async
@@ -805,13 +866,15 @@ def train_federated_streamed(
                     for wave_base, (wx, wy, wm) in stream:
                         part = partial_fn(
                             params, wx, wy, wm, np.int32(wave_base),
-                            round_key,
+                            round_key, survivors=surv,
                         )
                         acc = part if acc is None else accum_fn(acc, part)
                     params, stats = apply_fn(params, acc)
                 else:
                     wave_base, (wx, wy, wm) = next(iter(stream))
-                    params, stats = round_fn(params, wx, wy, wm, round_key)
+                    params, stats = round_fn(
+                        params, wx, wy, wm, round_key, survivors=surv
+                    )
         finally:
             stream.close()
         with obs.span("round.fetch", round=rnd + 1) as sp_fetch:
@@ -829,7 +892,32 @@ def train_federated_streamed(
             "waves": num_waves,
             "participants": int(np.asarray(stats_h.num_participants)),
         }
+        if guards:
+            # The casualty ledger (r11): exact per-round counts in the
+            # permanent record — dropped = sampled-but-died (survivor
+            # mask), rejected = non-finite updates quarantined in the
+            # round program; the chaos tests reconcile both against the
+            # fault plan. A min_participation skip is logged, never
+            # silent.
+            n_drop = int(round(float(np.asarray(stats_h.dropped_clients))))
+            n_rej = int(round(float(np.asarray(stats_h.rejected_updates))))
+            metrics["dropped_clients"] = n_drop
+            metrics["rejected_updates"] = n_rej
+            if n_drop:
+                obs.counter("fed.dropped_clients", n_drop)
+            if n_rej:
+                obs.counter("fed.rejected_updates", n_rej)
+            if float(np.asarray(stats_h.applied)) < 0.5:
+                metrics["skipped"] = True
+                obs.counter("fed.rounds_skipped")
         if accountant is not None:
+            # acct_q is a pure function of the SAMPLED cohort (set
+            # above, before the loop) — survivor counts never enter.
+            # Dropouts must not shrink the accounted q: the casualties
+            # were still selected by the mechanism's sampling step, so
+            # claiming a smaller q would overstate amplification;
+            # charging the full cohort is conservative
+            # (tests/test_faults.py pins ε dropout-invariant).
             accountant.step(
                 q=acct_q, sigma=cfg.dp.noise_multiplier,
                 num_steps=acct_steps,

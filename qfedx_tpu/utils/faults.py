@@ -1,0 +1,312 @@
+"""Deterministic fault injection — the chaos harness behind QFEDX_FAULTS.
+
+Cross-device federation at QFed scale is DEFINED by partial
+participation: clients die mid-round, local updates go non-finite,
+registries and filesystems hiccup. The r11 round machinery survives all
+of these (fed/round survivor masks + quarantine, data/stream +
+run/checkpoint retries) — this module makes those paths TESTABLE by
+injecting the failures deterministically at the real seams instead of
+hoping production reproduces them.
+
+A ``FaultPlan`` is a seeded list of rules. Every decision is a pure
+function of ``(seed, site, round, wave, client/attempt)`` via a
+SplitMix64 hash — no RNG state, so a plan fires identically across
+reruns, processes and resumes (the same counter-based-determinism
+design as ``data.stream.SyntheticRegistry``).
+
+Registered sites (the real seams; each consulted by production code,
+except ``distributed.peer`` which is consulted by the multi-process
+test harness):
+
+- ``client.compute`` — per-(round, client) casualties, ``kind``:
+  ``drop`` (client dies: it joins the round's survivor mask as 0, its
+  weighted contribution and secure-agg masks vanish — fed/round),
+  ``nan`` / ``inf`` (its local data is poisoned so its Δθ goes
+  non-finite and the quarantine path must catch it organically).
+- ``registry.fetch`` — transient error raised inside the WaveStream
+  uploader's fetch, before the registry is read (data/stream retries).
+- ``ingest.h2d`` — same, between host batch and ``device_put``.
+- ``checkpoint.write`` — transient error in the async checkpoint
+  writer's save attempt (run/checkpoint retries).
+- ``distributed.peer`` — a peer process's in-flight client is declared
+  dead: the 2-process gloo worker calls ``check("distributed.peer",
+  round, wave=peer)`` per peer and folds firing peers into the round's
+  survivor mask, so the casualty's ring partner lives on the OTHER
+  process (tests/_distributed_worker.py dropout mode).
+
+Rule spec (JSON or dict) — ``docs/ROBUSTNESS.md`` is the reference:
+
+    {"seed": 7, "rules": [
+      {"site": "client.compute", "kind": "drop", "clients": [3],
+       "rounds": [1]},                       # exact casualty
+      {"site": "client.compute", "kind": "nan", "rate": 0.05},
+      {"site": "registry.fetch", "rate": 1.0, "rounds": [0],
+       "times": 1}                           # fails attempt 0 only
+    ]}
+
+``rounds`` / ``waves`` restrict where a rule applies (absent = every-
+where); ``clients`` lists exact registry ids, ``rate`` draws per-client
+(client.compute) or per-(round, wave) (error sites) from the hash;
+``times`` bounds how many retry ATTEMPTS an error site fails — the
+transient/persistent dial (``times: 1`` + a 2-attempt retry = recovered,
+``times`` absent = fails every attempt = persistent).
+
+``QFEDX_FAULTS`` pins a plan process-wide: ``0``/``off`` (default) =
+none, a ``{...}`` literal = inline JSON, anything else = path to a JSON
+file. Read PER resolve (like QFEDX_TRACE) so tests flip it per run.
+With no plan active every hook below is a no-op and the guarded round
+program still runs — the faults-off bit-parity lever lives in
+fed/round's QFEDX_GUARDS, not here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import lru_cache
+from pathlib import Path
+
+import numpy as np
+
+SITES = (
+    "client.compute",
+    "registry.fetch",
+    "ingest.h2d",
+    "checkpoint.write",
+    "distributed.peer",
+)
+CLIENT_KINDS = ("drop", "nan", "inf")
+_ERROR_SITES = tuple(s for s in SITES if s != "client.compute")
+
+
+class FaultInjected(RuntimeError):
+    """A planned transient/persistent failure, raised at an error site.
+
+    Typed so retry policies and tests can distinguish injected chaos
+    from real failures; carries the site and the (round, wave, attempt)
+    coordinate that fired.
+    """
+
+    def __init__(self, site: str, round_idx: int, wave: int, attempt: int):
+        super().__init__(
+            f"injected fault at {site} (round={round_idx}, wave={wave}, "
+            f"attempt={attempt})"
+        )
+        self.site = site
+        self.round_idx = round_idx
+        self.wave = wave
+        self.attempt = attempt
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):  # mod-2^64 wraparound IS the mixer
+        x = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+        x ^= x >> np.uint64(30)
+        x *= np.uint64(0xBF58476D1CE4E5B9)
+        x ^= x >> np.uint64(27)
+        x *= np.uint64(0x94D049BB133111EB)
+        x ^= x >> np.uint64(31)
+    return x
+
+
+def _site_code(site: str) -> np.uint64:
+    return np.uint64(SITES.index(site) + 1)
+
+
+def _uniform(seed: int, site: str, round_idx: int, wave, ids) -> np.ndarray:
+    """[len(ids)] float64 in [0, 1), pure in every coordinate."""
+    ids = np.asarray(ids, dtype=np.uint64)
+    x = np.uint64(seed)
+    for part in (_site_code(site), np.uint64(round_idx + 1),
+                 np.uint64(int(wave) + 1)):
+        x = _splitmix64(x ^ part)
+    bits = _splitmix64(x ^ ids)
+    return (bits >> np.uint64(11)) / float(1 << 53)
+
+
+class _Rule:
+    def __init__(self, spec: dict):
+        unknown = set(spec) - {
+            "site", "kind", "rate", "clients", "rounds", "waves", "times"
+        }
+        if unknown:
+            raise ValueError(f"unknown fault-rule keys {sorted(unknown)}")
+        self.site = spec.get("site")
+        if self.site not in SITES:
+            raise ValueError(
+                f"fault rule site {self.site!r} not in {SITES}"
+            )
+        self.kind = spec.get("kind", "error")
+        if self.site == "client.compute":
+            if self.kind not in CLIENT_KINDS:
+                raise ValueError(
+                    f"client.compute kind {self.kind!r} not in {CLIENT_KINDS}"
+                )
+        elif self.kind != "error":
+            raise ValueError(
+                f"{self.site} supports only kind='error', got {self.kind!r}"
+            )
+        self.rate = spec.get("rate")
+        self.clients = (
+            None if spec.get("clients") is None
+            else np.asarray(spec["clients"], dtype=np.int64)
+        )
+        if self.site == "client.compute":
+            if (self.rate is None) == (self.clients is None):
+                raise ValueError(
+                    "client.compute rule needs exactly one of "
+                    "'rate' or 'clients'"
+                )
+        elif self.rate is None:
+            self.rate = 1.0
+        if self.rate is not None and not (0.0 <= float(self.rate) <= 1.0):
+            raise ValueError(f"rate must be in [0, 1], got {self.rate}")
+        self.rounds = (
+            None if spec.get("rounds") is None
+            else {int(r) for r in spec["rounds"]}
+        )
+        self.waves = (
+            None if spec.get("waves") is None
+            else {int(w) for w in spec["waves"]}
+        )
+        self.times = (
+            None if spec.get("times") is None else int(spec["times"])
+        )
+        if self.times is not None and self.times < 1:
+            raise ValueError(f"times must be >= 1, got {self.times}")
+
+    def applies(self, round_idx: int, wave) -> bool:
+        if self.rounds is not None and int(round_idx) not in self.rounds:
+            return False
+        if self.waves is not None and int(wave) not in self.waves:
+            return False
+        return True
+
+
+class FaultPlan:
+    """A seeded, deterministic fault schedule (module docstring spec)."""
+
+    def __init__(self, seed: int = 0, rules: list[dict] | None = None):
+        self.seed = int(seed)
+        self.rules = [_Rule(dict(r)) for r in (rules or [])]
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> "FaultPlan":
+        unknown = set(spec) - {"seed", "rules"}
+        if unknown:
+            raise ValueError(f"unknown fault-plan keys {sorted(unknown)}")
+        return cls(seed=spec.get("seed", 0), rules=spec.get("rules"))
+
+    @classmethod
+    def from_json(cls, text_or_path: str | os.PathLike) -> "FaultPlan":
+        text = str(text_or_path)
+        if not text.lstrip().startswith("{"):
+            text = Path(text).read_text()
+        return cls.from_spec(json.loads(text))
+
+    # -- client.compute casualties ------------------------------------------
+
+    def _client_hits(self, kind: str, round_idx: int, ids) -> np.ndarray:
+        ids = np.asarray(ids, dtype=np.int64)
+        hit = np.zeros(len(ids), dtype=bool)
+        for idx, rule in enumerate(self.rules):
+            if rule.site != "client.compute" or rule.kind != kind:
+                continue
+            if not rule.applies(round_idx, 0):
+                continue
+            if rule.clients is not None:
+                hit |= np.isin(ids, rule.clients)
+            else:
+                # Hash salted by the RULE's position (like ``check``)
+                # AND the kind index, so a drop rule and a nan rule at
+                # the same rate — or two overlapping drop rules — fall
+                # independent coin flips per client.
+                u = _uniform(
+                    self.seed + CLIENT_KINDS.index(kind)
+                    + 7919 * (idx + 1),
+                    "client.compute", round_idx, 0, ids,
+                )
+                hit |= u < float(rule.rate)
+        return hit
+
+    def survivors(self, round_idx: int, cohort_ids) -> np.ndarray:
+        """[len(cohort_ids)] float32 0/1: 0 = this client DROPS this
+        round (dies mid-round; fed/round zeroes its contribution and its
+        secure-agg masks never reach the aggregate)."""
+        return (~self._client_hits("drop", round_idx, cohort_ids)).astype(
+            np.float32
+        )
+
+    def poison(self, round_idx: int, cohort_ids) -> np.ndarray:
+        """[len(cohort_ids)] float32 multiplier injecting non-finite
+        client data: 1 = clean, nan/inf where a ``nan``/``inf`` rule
+        fires — multiplied into the client's features so its local
+        update goes non-finite and the quarantine must catch it."""
+        out = np.ones(len(np.asarray(cohort_ids)), dtype=np.float32)
+        out[self._client_hits("nan", round_idx, cohort_ids)] = np.nan
+        out[self._client_hits("inf", round_idx, cohort_ids)] = np.inf
+        return out
+
+    def casualty_counts(self, round_idx: int, cohort_ids) -> dict:
+        """{"drop": n, "nan": n, "inf": n} — the EXACT per-round casualty
+        ledger the chaos tests reconcile against metrics.jsonl."""
+        return {
+            k: int(self._client_hits(k, round_idx, cohort_ids).sum())
+            for k in CLIENT_KINDS
+        }
+
+    # -- error sites ---------------------------------------------------------
+
+    def check(
+        self, site: str, round_idx: int, wave: int = 0, attempt: int = 0
+    ) -> None:
+        """Raise ``FaultInjected`` if a rule fires at this coordinate.
+
+        Production seams call this with their retry ATTEMPT index: a
+        rule with ``times: t`` fails attempts 0..t-1 and then lets the
+        operation through — the transient-failure shape retries must
+        recover from. No matching rule (or attempt ≥ times) = no-op.
+        """
+        if site not in _ERROR_SITES:
+            raise ValueError(f"unknown error site {site!r}")
+        for idx, rule in enumerate(self.rules):
+            if rule.site != site or not rule.applies(round_idx, wave):
+                continue
+            if rule.times is not None and attempt >= rule.times:
+                continue
+            # Salt the hash with the RULE's position so two rate rules
+            # on the same site fall independent coins (the same
+            # independence _client_hits keys by kind).
+            u = _uniform(
+                self.seed + 7919 * (idx + 1), site, round_idx, wave, [0]
+            )[0]
+            if u < float(rule.rate):
+                from qfedx_tpu import obs
+
+                obs.counter(f"faults.injected.{site}")
+                raise FaultInjected(site, round_idx, wave, attempt)
+
+
+@lru_cache(maxsize=8)
+def _inline_plan(value: str) -> FaultPlan:
+    return FaultPlan.from_json(value)
+
+
+def active_plan() -> FaultPlan | None:
+    """The process-wide plan pinned by ``QFEDX_FAULTS`` (module
+    docstring grammar), or None. Read per call, like QFEDX_TRACE.
+    Inline ``{...}`` values are cached by their literal text; a FILE
+    path is re-read on every resolve — an operator editing the plan
+    behind an unchanged path must not be served a stale parse (the
+    per-call contract), and the files are tiny."""
+    value = os.environ.get("QFEDX_FAULTS", "")
+    if value.lower() in ("", "0", "off"):
+        return None
+    if value.lstrip().startswith("{"):
+        return _inline_plan(value)
+    return FaultPlan.from_json(value)
+
+
+def resolve_plan(fault_plan: FaultPlan | None = None) -> FaultPlan | None:
+    """An explicit plan argument wins; otherwise the QFEDX_FAULTS pin."""
+    return fault_plan if fault_plan is not None else active_plan()

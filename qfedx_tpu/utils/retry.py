@@ -1,0 +1,86 @@
+"""Exponential backoff with a deadline — the ONE retry policy for host I/O.
+
+The r10 ingestion/checkpoint threads treat every error as fatal: a
+single transient registry hiccup kills the uploader, a momentary
+filesystem stall fails the async checkpoint write, and in both cases the
+error only surfaces after the fact (ISSUE r11 satellites). At
+million-client scale transient host-side failures are the NORMAL case —
+the retry policy must be shared, deterministic, and bounded, not
+hand-rolled per call site (the same consolidation argument as
+``utils/pins``: by the time the third copy exists, two have drifted).
+
+``retry_with_deadline(fn)`` calls ``fn(attempt)`` up to ``attempts``
+times, sleeping ``base_delay · 2^k`` (capped at ``max_delay``) between
+tries, never past ``deadline_s`` total. The attempt INDEX is passed to
+``fn`` so callers can key deterministic fault injection
+(``utils/faults``) and logging off it. No jitter by design: the fault
+harness pins exact retry schedules, and these are single-consumer
+host threads, not a thundering herd.
+
+On exhaustion a typed ``RetryExhausted`` raises, chaining the last
+error (``__cause__``) and carrying ``attempts``/``elapsed_s`` — callers
+that need the root cause for their own typed error (``StreamError``,
+``CheckpointWriteError``) unwrap ``.last``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable
+
+
+class RetryExhausted(RuntimeError):
+    """All attempts failed (or the deadline expired); ``.last`` is the
+    final error, also chained as ``__cause__``."""
+
+    def __init__(self, describe: str, attempts: int, elapsed_s: float,
+                 last: BaseException):
+        super().__init__(
+            f"{describe} failed after {attempts} attempt(s) in "
+            f"{elapsed_s:.2f}s: {type(last).__name__}: {last}"
+        )
+        self.attempts = attempts
+        self.elapsed_s = elapsed_s
+        self.last = last
+
+
+def retry_with_deadline(
+    fn: Callable[[int], Any],
+    *,
+    attempts: int = 3,
+    base_delay_s: float = 0.05,
+    max_delay_s: float = 1.0,
+    deadline_s: float = 30.0,
+    retry_on: Iterable[type[BaseException]] = (Exception,),
+    describe: str = "operation",
+    sleep: Callable[[float], None] = time.sleep,
+) -> Any:
+    """Run ``fn(attempt)``, retrying failed attempts with exponential
+    backoff until success, ``attempts`` tries, or ``deadline_s`` wall —
+    whichever first. Non-``retry_on`` exceptions propagate immediately
+    (a KeyboardInterrupt must never be eaten by a backoff loop).
+    ``sleep`` is injectable so tests pin the schedule without waiting.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    retry_on = tuple(retry_on)
+    t0 = time.monotonic()
+    last: BaseException | None = None
+    for k in range(attempts):
+        try:
+            return fn(k)
+        except retry_on as exc:  # noqa: PERF203 — the loop IS the policy
+            last = exc
+            elapsed = time.monotonic() - t0
+            out_of_time = elapsed >= deadline_s
+            if k == attempts - 1 or out_of_time:
+                raise RetryExhausted(
+                    describe, k + 1, elapsed, last
+                ) from last
+            delay = min(base_delay_s * (2.0 ** k), max_delay_s)
+            # Never sleep past the deadline: the next attempt must start
+            # while there is still budget to fail it properly.
+            delay = min(delay, max(0.0, deadline_s - elapsed))
+            if delay > 0:
+                sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover

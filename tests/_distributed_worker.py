@@ -16,7 +16,15 @@ compare against the single-process oracle.
 psum crosses the process boundary via gloo), accumulated and applied by
 ``make_apply_partial`` — so cross-wave secure-agg mask cancellation is
 exercised over REAL cross-process collectives, not just the virtual
-mesh.
+mesh. ``dropout`` (r11) is ``hier`` plus a mid-round casualty decided
+by the ``distributed.peer`` fault site: each process consults
+``FaultPlan.check("distributed.peer", round, wave=peer)`` per peer
+(deterministic — all controllers agree with zero communication) and a
+firing peer's wave-0 client joins the survivor mask as dead. The rule
+targets peer 1, so client 1 (process 1, wave 0) dies and the surviving
+ring over {0, 2, 3} pairs client 0 with partners in the OTHER wave on
+the OTHER process — dropout-resilient mask cancellation across both
+the wave split and the process boundary.
 """
 
 import os
@@ -63,7 +71,7 @@ def main() -> None:
     from qfedx_tpu.fed.round import make_fed_round
     from qfedx_tpu.models.vqc import make_vqc_classifier
 
-    if mode == "hier":
+    if mode in ("hier", "dropout"):
         # 4-client cohort split into 2 waves of 2 (one client per
         # process per wave); sgd keeps the wave-split comparison
         # float-tight (tests/test_hier.py's tolerance rationale), ring
@@ -100,12 +108,35 @@ def main() -> None:
     )
     key = globalize(np.asarray(jax.random.PRNGKey(42)), P())
 
-    if mode == "hier":
+    if mode in ("hier", "dropout"):
         from qfedx_tpu.fed.round import (
             make_accumulate_partial,
             make_apply_partial,
             make_fed_round_partial,
         )
+
+        survivors = None
+        if mode == "dropout":
+            # The distributed.peer fault site decides the casualty:
+            # every process consults check(round=0, wave=peer) for each
+            # peer — deterministic, so all controllers agree without
+            # communication — and a firing peer's wave-0 client joins
+            # the survivor mask as dead. The rule targets peer 1, whose
+            # wave-0 client (id 1) then has surviving ring partners
+            # only in the other wave / on the other process.
+            from qfedx_tpu.utils.faults import FaultInjected, FaultPlan
+
+            plan = FaultPlan(seed=0, rules=[{
+                "site": "distributed.peer", "rounds": [0], "waves": [1],
+            }])
+            surv_np = np.ones(num_clients, dtype=np.float32)
+            for peer in range(int(nproc)):
+                try:
+                    plan.check("distributed.peer", 0, wave=peer)
+                except FaultInjected:
+                    surv_np[peer] = 0.0  # peer's wave-0 client dies
+            assert surv_np.tolist() == [1.0, 0.0, 1.0, 1.0]
+            survivors = globalize(surv_np, P())
 
         wave = int(nproc)  # one client per process per wave
         partial_fn = make_fed_round_partial(
@@ -119,7 +150,8 @@ def main() -> None:
             wy = globalize(cy[sl], P("clients"))
             wm = globalize(cm[sl], P("clients"))
             wb = globalize(np.asarray(w * wave, dtype=np.int32), P())
-            part = partial_fn(params, wx, wy, wm, wb, key)
+            part = partial_fn(params, wx, wy, wm, wb, key,
+                              survivors=survivors)
             acc = part if acc is None else accum(acc, part)
         new_params, stats = make_apply_partial()(params, acc)
     else:
@@ -137,6 +169,7 @@ def main() -> None:
         }
         leaves["mean_loss"] = np.asarray(stats.mean_loss)
         leaves["total_weight"] = np.asarray(stats.total_weight)
+        leaves["num_participants"] = np.asarray(stats.num_participants)
         np.savez(out_path, **leaves)
     print(f"worker {pid} done", flush=True)
 

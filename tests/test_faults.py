@@ -1,0 +1,365 @@
+"""Fault-injection harness (r11): plan determinism, retry policy, the
+QFEDX_FAULTS pin, DP-accountant dropout invariance, and the tier-1
+chaos smoke test — a short streamed run with a mixed fault plan (one
+NaN client, one dropped client, one transient registry failure) must
+complete, converge, and report EXACT casualty counts in metrics.jsonl.
+
+Shapes are tiny (3 qubits, 1 layer, 8–16 clients): this file sits
+mid-alphabet in the tier-1 wall-clock budget.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from qfedx_tpu.fed.config import DPConfig, FedConfig
+from qfedx_tpu.fed.round import client_mesh
+from qfedx_tpu.models.vqc import make_vqc_classifier
+from qfedx_tpu.utils.faults import (
+    FaultInjected,
+    FaultPlan,
+    active_plan,
+    resolve_plan,
+)
+from qfedx_tpu.utils.retry import RetryExhausted, retry_with_deadline
+
+N_Q = 3
+
+
+# --- FaultPlan --------------------------------------------------------------
+
+
+def test_plan_is_deterministic_and_kind_independent():
+    plan = FaultPlan(seed=3, rules=[
+        {"site": "client.compute", "kind": "drop", "rate": 0.3},
+        {"site": "client.compute", "kind": "nan", "rate": 0.3},
+    ])
+    ids = np.arange(64)
+    s1, s2 = plan.survivors(5, ids), plan.survivors(5, ids)
+    np.testing.assert_array_equal(s1, s2)  # pure in (seed, round, ids)
+    assert 0 < (s1 == 0).sum() < 64
+    assert not np.array_equal(s1, plan.survivors(6, ids))  # varies by round
+    assert not np.array_equal(
+        s1, FaultPlan(seed=4, rules=plan_rules(plan)).survivors(5, ids)
+    )
+    # drop and nan draws are independent coins, not the same hash
+    pois = plan.poison(5, ids)
+    nan_hit = ~np.isfinite(pois)
+    assert 0 < nan_hit.sum() < 64
+    assert not np.array_equal(nan_hit, s1 == 0)
+    counts = plan.casualty_counts(5, ids)
+    assert counts["drop"] == int((s1 == 0).sum())
+    assert counts["nan"] == int(nan_hit.sum())
+    assert counts["inf"] == 0
+
+
+def plan_rules(plan):
+    return [
+        {"site": "client.compute", "kind": "drop", "rate": 0.3},
+        {"site": "client.compute", "kind": "nan", "rate": 0.3},
+    ]
+
+
+def test_plan_exact_clients_rounds_and_error_sites():
+    plan = FaultPlan.from_spec({"seed": 1, "rules": [
+        {"site": "client.compute", "kind": "drop", "clients": [3, 7],
+         "rounds": [2]},
+        {"site": "registry.fetch", "rounds": [1], "waves": [0], "times": 1},
+        {"site": "checkpoint.write", "rounds": [4]},
+    ]})
+    ids = np.arange(8)
+    np.testing.assert_array_equal(
+        plan.survivors(2, ids),
+        np.array([1, 1, 1, 0, 1, 1, 1, 0], np.float32),
+    )
+    np.testing.assert_array_equal(plan.survivors(3, ids), np.ones(8))
+    # id-keyed, not position-keyed: a different cohort still drops 3, 7
+    np.testing.assert_array_equal(
+        plan.survivors(2, np.array([2, 3, 7])),
+        np.array([1, 0, 0], np.float32),
+    )
+    # transient: attempt 0 fails, attempt 1 passes; other coords clean
+    with pytest.raises(FaultInjected) as ei:
+        plan.check("registry.fetch", 1, wave=0, attempt=0)
+    assert ei.value.site == "registry.fetch" and ei.value.round_idx == 1
+    plan.check("registry.fetch", 1, wave=0, attempt=1)
+    plan.check("registry.fetch", 0, wave=0, attempt=0)
+    plan.check("registry.fetch", 1, wave=1, attempt=0)
+    # persistent: no times bound — every attempt fails
+    for k in range(4):
+        with pytest.raises(FaultInjected):
+            plan.check("checkpoint.write", 4, attempt=k)
+
+
+def test_same_site_rate_rules_fall_independent_coins():
+    """Two rate rules on one error site must not fire on perfectly
+    correlated coordinates (each rule's hash is salted by its position
+    in the plan)."""
+    plan = FaultPlan(seed=0, rules=[
+        {"site": "registry.fetch", "rate": 0.5},
+        {"site": "registry.fetch", "rate": 0.5},
+    ])
+    single = FaultPlan(seed=0, rules=[
+        {"site": "registry.fetch", "rate": 0.5},
+    ])
+    both_fire = one_fires = 0
+    for r in range(200):
+        a = fires(single, r)
+        b = fires(plan, r)
+        one_fires += a
+        both_fire += b
+    # Rule 1 alone fires ~50%; with an INDEPENDENT second coin the
+    # union fires ~75% — correlated rules would leave it at ~50%.
+    assert 70 <= one_fires <= 130
+    assert both_fire > one_fires + 20
+
+
+def fires(plan, round_idx) -> bool:
+    try:
+        plan.check("registry.fetch", round_idx)
+        return False
+    except FaultInjected:
+        return True
+
+
+def test_plan_spec_validation():
+    with pytest.raises(ValueError, match="site"):
+        FaultPlan(rules=[{"site": "nonsense"}])
+    with pytest.raises(ValueError, match="kind"):
+        FaultPlan(rules=[{"site": "client.compute", "kind": "error",
+                          "rate": 0.1}])
+    with pytest.raises(ValueError, match="exactly one"):
+        FaultPlan(rules=[{"site": "client.compute", "kind": "drop"}])
+    with pytest.raises(ValueError, match="rate"):
+        FaultPlan(rules=[{"site": "client.compute", "kind": "drop",
+                          "rate": 1.5}])
+    with pytest.raises(ValueError, match="unknown fault-rule keys"):
+        FaultPlan(rules=[{"site": "registry.fetch", "typo": 1}])
+    with pytest.raises(ValueError, match="unknown fault-plan keys"):
+        FaultPlan.from_spec({"seeds": 1})
+    with pytest.raises(ValueError, match="unknown error site"):
+        FaultPlan().check("client.compute", 0)
+
+
+def test_faults_pin_grammar(monkeypatch, tmp_path):
+    monkeypatch.delenv("QFEDX_FAULTS", raising=False)
+    assert active_plan() is None
+    monkeypatch.setenv("QFEDX_FAULTS", "off")
+    assert active_plan() is None
+    inline = json.dumps({"seed": 2, "rules": [
+        {"site": "client.compute", "kind": "drop", "clients": [1]},
+    ]})
+    monkeypatch.setenv("QFEDX_FAULTS", inline)
+    plan = active_plan()
+    assert plan is not None and plan.seed == 2
+    path = tmp_path / "plan.json"
+    path.write_text(inline)
+    monkeypatch.setenv("QFEDX_FAULTS", str(path))
+    assert active_plan().seed == 2
+    # an explicit argument beats the pin
+    override = FaultPlan(seed=9)
+    assert resolve_plan(override) is override
+    assert resolve_plan(None).seed == 2
+
+
+# --- retry helper -----------------------------------------------------------
+
+
+def test_retry_recovers_and_exhausts():
+    sleeps = []
+    calls = []
+
+    def flaky(k):
+        calls.append(k)
+        if k < 2:
+            raise OSError(f"boom {k}")
+        return "ok"
+
+    out = retry_with_deadline(
+        flaky, attempts=3, base_delay_s=0.05, sleep=sleeps.append,
+        describe="flaky op",
+    )
+    assert out == "ok" and calls == [0, 1, 2]
+    assert sleeps == [0.05, 0.1]  # exponential, deterministic
+
+    def always(k):
+        raise OSError("disk gone")
+
+    with pytest.raises(RetryExhausted) as ei:
+        retry_with_deadline(
+            always, attempts=3, sleep=lambda s: None, describe="doomed"
+        )
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last, OSError)
+    assert isinstance(ei.value.__cause__, OSError)
+    assert "doomed" in str(ei.value) and "disk gone" in str(ei.value)
+
+
+def test_retry_respects_deadline_and_error_filter():
+    import time
+
+    t = {"now": 0.0}
+    real_monotonic = time.monotonic
+    try:
+        time.monotonic = lambda: t["now"]
+
+        def slow_fail(k):
+            t["now"] += 6.0
+            raise OSError("slow")
+
+        with pytest.raises(RetryExhausted) as ei:
+            retry_with_deadline(
+                slow_fail, attempts=10, deadline_s=10.0,
+                sleep=lambda s: None,
+            )
+        assert ei.value.attempts == 2  # deadline cut it, not attempts
+    finally:
+        time.monotonic = real_monotonic
+    # non-retry_on errors propagate immediately
+    with pytest.raises(KeyboardInterrupt):
+        retry_with_deadline(
+            lambda k: (_ for _ in ()).throw(KeyboardInterrupt()),
+            attempts=5, sleep=lambda s: None,
+        )
+
+
+# --- DP accountant dropout invariance (satellite) ---------------------------
+
+
+def test_epsilon_unchanged_by_injected_dropouts():
+    """The accountant charges the SAMPLED cohort: a run with 25% of
+    clients dropping every round reports the exact same per-round ε as
+    the casualty-free run — dropout never shrinks the accounted q."""
+    from qfedx_tpu.data.stream import ArrayRegistry
+    from qfedx_tpu.run.trainer import train_federated_streamed
+
+    rng = np.random.default_rng(0)
+    cx = rng.uniform(0, 1, (16, 4, N_Q)).astype(np.float32)
+    cy = (cx.mean(axis=2) > 0.5).astype(np.int32)
+    cm = np.ones((16, 4), dtype=np.float32)
+    tx, ty = cx[:, 0, :], cy[:, 0]
+    model = make_vqc_classifier(n_qubits=N_Q, n_layers=1, num_classes=2)
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.1,
+        client_fraction=0.5,
+        dp=DPConfig(clip_norm=1.0, noise_multiplier=1.0),
+    )
+    reg = ArrayRegistry(cx, cy, cm)
+    mesh = client_mesh(num_devices=4)
+    kw = dict(cohort_size=8, wave_size=8, num_rounds=2, seed=1,
+              eval_every=3, mesh=mesh)
+    clean = train_federated_streamed(model, cfg, reg, tx, ty, **kw)
+    plan = FaultPlan(seed=5, rules=[
+        {"site": "client.compute", "kind": "drop", "rate": 0.25},
+    ])
+    faulty = train_federated_streamed(
+        model, cfg, reg, tx, ty, fault_plan=plan, **kw
+    )
+    assert clean.epsilons == faulty.epsilons
+    assert len(clean.epsilons) == 2
+
+
+# --- the tier-1 chaos smoke test (satellite) --------------------------------
+
+
+def test_chaos_smoke_streamed_run(tmp_path):
+    """A streamed run under a mixed fault plan — per round: client 3
+    drops, client 5's data goes NaN, and round 1 wave 0's registry
+    fetch fails once transiently — must complete without error, keep θ
+    finite, converge on the learnable synthetic task, and report the
+    EXACT casualty counts in metrics.jsonl."""
+    import jax
+
+    from qfedx_tpu.data.stream import ArrayRegistry
+    from qfedx_tpu.run.metrics import MetricsLogger
+    from qfedx_tpu.run.trainer import train_federated_streamed
+
+    rng = np.random.default_rng(7)
+    C, S = 8, 16
+    cx = rng.uniform(0, 1, (C, S, N_Q)).astype(np.float32)
+    cy = (cx.mean(axis=2) > 0.5).astype(np.int32)
+    cm = np.ones((C, S), dtype=np.float32)
+    tx = rng.uniform(0, 1, (64, N_Q)).astype(np.float32)
+    ty = (tx.mean(axis=1) > 0.5).astype(np.int32)
+    model = make_vqc_classifier(n_qubits=N_Q, n_layers=2, num_classes=2)
+    cfg = FedConfig(local_epochs=2, batch_size=8, learning_rate=0.1,
+                    optimizer="adam", secure_agg=True,
+                    secure_agg_mode="ring")
+    plan = FaultPlan(seed=0, rules=[
+        {"site": "client.compute", "kind": "drop", "clients": [3]},
+        {"site": "client.compute", "kind": "nan", "clients": [5]},
+        {"site": "registry.fetch", "rounds": [1], "waves": [0], "times": 1},
+    ])
+    mesh = client_mesh(num_devices=4)
+    logger = MetricsLogger(tmp_path / "metrics.jsonl")
+    num_rounds = 8
+    res = train_federated_streamed(
+        model, cfg, ArrayRegistry(cx, cy, cm), tx, ty,
+        cohort_size=C, wave_size=4, num_rounds=num_rounds, seed=2,
+        eval_every=2, mesh=mesh, fault_plan=plan,
+        on_round_end=lambda r, m: logger.log(m),
+    )
+    logger.close()
+    for leaf in jax.tree.leaves(res.params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+    assert all(np.isfinite(res.losses))
+    assert res.final_accuracy > 0.7  # converged despite 25% casualties
+    rows = [
+        json.loads(line)
+        for line in (tmp_path / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert len(rows) == num_rounds
+    for r, row in enumerate(rows):
+        want = plan.casualty_counts(r, np.arange(C))
+        assert row["dropped_clients"] == want["drop"] == 1
+        assert row["rejected_updates"] == want["nan"] + want["inf"] == 1
+        assert row["participants"] == C - 2
+        assert "skipped" not in row
+
+
+@pytest.mark.slow
+def test_twenty_rounds_ten_percent_casualties_within_noise():
+    """The r11 acceptance run: 20 streamed rounds with ~10% injected
+    casualties per round (drops + NaN updates mixed) completes, θ stays
+    finite every round, and final accuracy lands within noise of the
+    casualty-free run."""
+    import jax
+
+    from qfedx_tpu.data.stream import SyntheticRegistry
+    from qfedx_tpu.run.trainer import train_federated_streamed
+
+    registry = SyntheticRegistry(
+        1 << 16, samples=16, n_features=N_Q, seed=3
+    )
+    ex, ey, _ = registry.batch(np.arange((1 << 16) - 16, 1 << 16))
+    tx, ty = ex.reshape(-1, N_Q), ey.reshape(-1)
+    model = make_vqc_classifier(n_qubits=N_Q, n_layers=2, num_classes=2)
+    cfg = FedConfig(local_epochs=2, batch_size=8, learning_rate=0.1,
+                    optimizer="adam", secure_agg=True,
+                    secure_agg_mode="ring")
+    mesh = client_mesh(num_devices=4)
+    kw = dict(cohort_size=16, wave_size=8, num_rounds=20, seed=4,
+              eval_every=5, mesh=mesh)
+    clean = train_federated_streamed(model, cfg, registry, tx, ty, **kw)
+    plan = FaultPlan(seed=1, rules=[
+        {"site": "client.compute", "kind": "drop", "rate": 0.05},
+        {"site": "client.compute", "kind": "nan", "rate": 0.05},
+    ])
+    chaos = train_federated_streamed(
+        model, cfg, registry, tx, ty, fault_plan=plan, **kw
+    )
+    for leaf in jax.tree.leaves(chaos.params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+    assert all(np.isfinite(chaos.losses))
+    from qfedx_tpu.fed.sampling import CohortSampler
+
+    sampler = CohortSampler(
+        registry_size=1 << 16, cohort_size=16, seed=4
+    )
+    total = sum(
+        sum(plan.casualty_counts(r, sampler.round_ids(r)).values())
+        for r in range(20)
+    )
+    assert total > 10  # the plan actually fired ~10%/round
+    assert chaos.final_accuracy > clean.final_accuracy - 0.1

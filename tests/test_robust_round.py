@@ -1,0 +1,393 @@
+"""Dropout-resilient aggregation parity pins (r11 tentpole).
+
+The three contracts the fault-tolerant round stands on:
+
+(a) **Guards on + zero casualties ≡ the unguarded (r10) program.** The
+    quarantine/survivor machinery must be free when nothing fails.
+    sgd/DP/adam-without-SA rows are BIT-identical; the secure-agg rows
+    carry the measured XLA:CPU compile-structure tolerances — the same
+    class tests/test_hier.py documents (adam+SA drift persists with
+    ``secure_agg_scale=0``, i.e. it is adam's rsqrt path compiling
+    differently in a structurally different program, not mask residue;
+    re-measured for this file's matrix on CPU).
+(b) **A round with dropouts ≡ the survivor-only round, bit for bit.**
+    The survivor mask restricts the EFFECTIVE participation set that
+    weights and pair graphs run over, so a casualty's exclusion is
+    arithmetically the same program as never sampling it — pinned by
+    monkeypatching ``participation_mask`` to return the
+    survivor-restricted set directly.
+(c) **lr=0 mask cancellation with dropouts.** With learning_rate=0
+    every delta is 0, so the aggregate is pure ring masks — which must
+    cancel to float dust even when clients drop, including casualties
+    whose ring partners live in other waves; plus the explicit
+    server-side ``unmatched_mask_sum`` oracle (masks drawn over the
+    PRE-dropout graph, casualty masks regenerated and subtracted).
+
+Shapes tiny (3 qubits, 1 layer, 16 clients) — tier-1 budget.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import qfedx_tpu.fed.round as fed_round
+from qfedx_tpu.fed.config import DPConfig, FedConfig
+from qfedx_tpu.fed.round import (
+    client_mesh,
+    guards_enabled,
+    make_accumulate_partial,
+    make_apply_partial,
+    make_fed_round,
+    make_fed_round_partial,
+    shard_client_data,
+)
+from qfedx_tpu.fed.sampling import participation_mask
+from qfedx_tpu.fed.secure_agg import ring_mask, unmatched_mask_sum
+from qfedx_tpu.models.vqc import make_vqc_classifier
+from qfedx_tpu.utils import trees
+
+C, S, N_Q = 16, 4, 3
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    cx = rng.uniform(0, 1, (C, S, N_Q)).astype(np.float32)
+    cy = (cx.mean(axis=2) > 0.5).astype(np.int32)
+    cm = np.ones((C, S), dtype=np.float32)
+    return cx, cy, cm
+
+
+def _model():
+    return make_vqc_classifier(n_qubits=N_Q, n_layers=1, num_classes=2)
+
+
+def _cfg(**kw):
+    base = dict(local_epochs=1, batch_size=4, learning_rate=0.1,
+                optimizer="sgd", client_fraction=0.5)
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def test_guards_pin_parses(monkeypatch):
+    monkeypatch.setenv("QFEDX_GUARDS", "off")
+    assert guards_enabled() is False
+    monkeypatch.delenv("QFEDX_GUARDS", raising=False)
+    assert guards_enabled() is True
+    monkeypatch.setenv("QFEDX_GUARDS", "sometimes")
+    with pytest.raises(ValueError):
+        guards_enabled()
+
+
+def test_guards_off_wrapper_keeps_signature(monkeypatch):
+    """Guards on or off, the builders return the SAME signature:
+    survivors=None is accepted everywhere (no caller branching), while
+    a real mask against the unguarded program raises loudly instead of
+    being silently dropped."""
+    monkeypatch.setenv("QFEDX_GUARDS", "off")
+    cfg = _cfg()
+    model = _model()
+    mesh = client_mesh(num_devices=4)
+    cx, cy, cm = _data()
+    params = model.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(1)
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+    fn = make_fed_round(model, cfg, mesh, num_clients=C)
+    fn(params, scx, scy, scm, key, survivors=None)  # accepted
+    with pytest.raises(ValueError, match="QFEDX_GUARDS"):
+        fn(params, scx, scy, scm, key,
+           survivors=np.ones(C, dtype=np.float32))
+    pf = make_fed_round_partial(
+        model, cfg, mesh, wave_clients=C, cohort_clients=C
+    )
+    pf(params, scx, scy, scm, np.int32(0), key, survivors=None)
+    with pytest.raises(ValueError, match="QFEDX_GUARDS"):
+        pf(params, scx, scy, scm, np.int32(0), key,
+           survivors=np.ones(C, dtype=np.float32))
+
+
+# (a) guards on + zero casualties vs the unguarded program. atol=None
+# means bit-identical; the SA rows carry the measured compile-structure
+# tolerances (module docstring).
+PARITY = [
+    # adam-without-SA is bit-identical too (measured); the row is
+    # omitted to keep this file inside the tier-1 wall-clock budget —
+    # sgd_dp pins the DP composition, adam_sa pins adam.
+    ("sgd_plain", dict(), None),
+    ("sgd_dp", dict(dp=DPConfig(clip_norm=1.0, noise_multiplier=0.5)), None),
+    ("sgd_sa", dict(secure_agg=True, secure_agg_mode="ring"), 1e-7),
+    ("adam_sa", dict(optimizer="adam", secure_agg=True,
+                     secure_agg_mode="ring"), 5e-3),
+]
+
+
+@pytest.mark.parametrize("label,kw,atol", PARITY, ids=[p[0] for p in PARITY])
+def test_guards_on_zero_casualties_matches_unguarded(
+    monkeypatch, label, kw, atol
+):
+    cfg = _cfg(**kw)
+    model = _model()
+    mesh = client_mesh(num_devices=4)
+    cx, cy, cm = _data()
+    params = model.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(7)
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+    monkeypatch.delenv("QFEDX_GUARDS", raising=False)
+    p_on, s_on = make_fed_round(model, cfg, mesh, num_clients=C)(
+        params, scx, scy, scm, key
+    )
+    monkeypatch.setenv("QFEDX_GUARDS", "off")
+    p_off, s_off = make_fed_round(model, cfg, mesh, num_clients=C)(
+        params, scx, scy, scm, key
+    )
+    for a, b in zip(jax.tree.leaves(p_on), jax.tree.leaves(p_off)):
+        if atol is None:
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        else:
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=atol, rtol=0
+            )
+    assert int(s_on.num_participants) == int(s_off.num_participants)
+    assert float(s_on.rejected_updates) == 0.0
+    assert float(s_on.dropped_clients) == 0.0
+    assert float(s_on.applied) == 1.0
+
+
+def test_dropout_round_is_bitexact_survivor_only_round(monkeypatch):
+    """(b): a round where clients DIE equals, bit for bit, the round
+    where they were never sampled — the in-program mask-recovery
+    contract. The reference injects the survivor-restricted set through
+    ``participation_mask`` itself (a different code path producing the
+    same effective set)."""
+    cfg = _cfg(secure_agg=True, secure_agg_mode="ring")
+    model = _model()
+    mesh = client_mesh(num_devices=4)
+    cx, cy, cm = _data(seed=3)
+    params = model.init(jax.random.PRNGKey(1))
+    key = jax.random.PRNGKey(9)
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+
+    part = np.asarray(participation_mask(key, C, cfg.client_fraction))
+    surv = np.ones(C, dtype=np.float32)
+    surv[[2, 7, 11]] = 0.0  # casualties: some sampled, some not
+    eff = (part * surv).astype(np.float32)
+
+    p_drop, s_drop = make_fed_round(model, cfg, mesh, num_clients=C)(
+        params, scx, scy, scm, key, survivors=surv
+    )
+    monkeypatch.setattr(
+        fed_round, "participation_mask",
+        lambda k, n, f: jnp.asarray(eff),
+    )
+    p_ref, s_ref = make_fed_round(model, cfg, mesh, num_clients=C)(
+        params, scx, scy, scm, key
+    )
+    for a, b in zip(jax.tree.leaves(p_drop), jax.tree.leaves(p_ref)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert float(s_drop.mean_loss) == float(s_ref.mean_loss)
+    assert int(s_drop.num_participants) == int(eff.sum())
+    assert int(s_drop.dropped_clients) == int((part * (1 - surv)).sum())
+
+
+def test_dropout_result_ignores_casualty_data():
+    """The casualty's data must be fully excluded: replacing a dropped
+    client's examples with garbage changes nothing, bitwise."""
+    cfg = _cfg(client_fraction=1.0, secure_agg=True)
+    model = _model()
+    mesh = client_mesh(num_devices=4)
+    cx, cy, cm = _data(seed=5)
+    params = model.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(3)
+    surv = np.ones(C, dtype=np.float32)
+    surv[6] = 0.0
+    fn = make_fed_round(model, cfg, mesh, num_clients=C)
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+    p1, _ = fn(params, scx, scy, scm, key, survivors=surv)
+    cx2 = cx.copy()
+    cx2[6] = np.nan  # even garbage that would NaN the whole psum
+    sgx, sgy, sgm = shard_client_data(mesh, cx2, cy, jnp.asarray(cm))
+    p2, _ = fn(params, sgx, sgy, sgm, key, survivors=surv)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("waves", [4])
+def test_lr0_masks_cancel_with_dropouts_across_waves(waves):
+    """(c): lr=0 ⇒ the accumulated update_sum is pure ring masks over
+    the surviving set — required ~0 for every wave split, with
+    casualties whose ring partners live in OTHER waves."""
+    cfg = _cfg(learning_rate=0.0, momentum=0.0, secure_agg=True,
+               client_fraction=1.0)
+    model = _model()
+    mesh = client_mesh(num_devices=4)
+    cx, cy, cm = _data(seed=1)
+    params = model.init(jax.random.PRNGKey(2))
+    key = jax.random.PRNGKey(4)
+    surv = np.ones(C, dtype=np.float32)
+    surv[[1, 9]] = 0.0  # wave 0 and wave 2 casualties at waves=4
+    wc = C // waves
+    pf = make_fed_round_partial(
+        model, cfg, mesh, wave_clients=wc, cohort_clients=C
+    )
+    accum = make_accumulate_partial()
+    acc = None
+    for w in range(waves):
+        sl = slice(w * wc, (w + 1) * wc)
+        wx, wy, wm = shard_client_data(
+            mesh, cx[sl], cy[sl], jnp.asarray(cm[sl])
+        )
+        part = pf(params, wx, wy, wm, np.int32(w * wc), key,
+                  survivors=surv)
+        acc = part if acc is None else accum(acc, part)
+    residual = max(
+        float(jnp.max(jnp.abs(leaf)))
+        for leaf in jax.tree.leaves(acc.update_sum)
+    )
+    assert residual < 1e-5, (
+        f"masks left {residual} with dropouts across {waves} waves"
+    )
+    assert int(acc.num_participants) == C - 2
+    assert int(acc.dropped_clients) == 2
+
+
+def test_unmatched_mask_sum_is_the_server_side_correction():
+    """The explicit recovery oracle: masks drawn over the PRE-dropout
+    pair graph, summed over survivors only, leave exactly the dropped
+    clients' unmatched masks — which the server regenerates
+    (deterministic keys) and subtracts to float dust."""
+    key = jax.random.PRNGKey(11)
+    template = {"a": jnp.zeros((5,)), "b": jnp.zeros((2, 3))}
+    part = jnp.asarray(
+        np.array([1, 1, 0, 1, 1, 1, 0, 1], dtype=np.float32)
+    )
+    surv = jnp.asarray(
+        np.array([1, 0, 1, 1, 1, 0, 1, 1], dtype=np.float32)
+    )
+    n = 8
+    survivor_sum = trees.tree_zeros_like(template)
+    for i in range(n):
+        m = ring_mask(key, i, n, template, part, scale=1.0, neighbors=2)
+        survivor_sum = jax.tree.map(
+            lambda a, x: a + surv[i] * x, survivor_sum, m
+        )
+    # Survivors alone do NOT cancel (the unmatched-mask corruption)...
+    residue = max(
+        float(jnp.max(jnp.abs(leaf)))
+        for leaf in jax.tree.leaves(survivor_sum)
+    )
+    assert residue > 0.1
+    # ...until the server adds the regenerated casualty masks back.
+    correction = unmatched_mask_sum(
+        key, n, template, part, surv, scale=1.0, neighbors=2
+    )
+    recovered = jax.tree.map(jnp.add, survivor_sum, correction)
+    assert max(
+        float(jnp.max(jnp.abs(leaf)))
+        for leaf in jax.tree.leaves(recovered)
+    ) < 1e-5
+
+
+def test_nan_quarantine_never_reaches_theta():
+    """A client whose data (hence Δθ) goes non-finite is zeroed and
+    counted; θ stays finite, and the result is within mask dust of
+    dropping the client outright (its regenerated masks stay in the
+    sum, so only the pair-graph float dust differs)."""
+    cfg = _cfg(client_fraction=1.0, secure_agg=True)
+    model = _model()
+    mesh = client_mesh(num_devices=4)
+    cx, cy, cm = _data(seed=8)
+    params = model.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(6)
+    fn = make_fed_round(model, cfg, mesh, num_clients=C)
+    bad = cx.copy()
+    bad[4] = np.inf
+    sbx, sby, sbm = shard_client_data(mesh, bad, cy, jnp.asarray(cm))
+    p_q, s_q = fn(params, sbx, sby, sbm, key)
+    for leaf in jax.tree.leaves(p_q):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+    assert np.isfinite(float(s_q.mean_loss))
+    assert int(s_q.rejected_updates) == 1
+    assert int(s_q.num_participants) == C - 1
+    # vs. an explicit drop of the same client: same surviving data terms
+    surv = np.ones(C, dtype=np.float32)
+    surv[4] = 0.0
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+    p_d, s_d = fn(params, scx, scy, scm, key, survivors=surv)
+    for a, b in zip(jax.tree.leaves(p_q), jax.tree.leaves(p_d)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=0
+        )
+    assert int(s_d.num_participants) == C - 1
+
+
+def test_min_participation_skips_round_identity():
+    """Graceful degradation: below the survivor floor the apply is the
+    IDENTITY (θ bitwise unchanged, applied=0); above it the round
+    proceeds."""
+    cfg = _cfg(client_fraction=1.0, min_participation=0.75)
+    model = _model()
+    mesh = client_mesh(num_devices=4)
+    cx, cy, cm = _data(seed=2)
+    params = model.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(5)
+    fn = make_fed_round(model, cfg, mesh, num_clients=C)
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+    surv = np.ones(C, dtype=np.float32)
+    surv[: C // 2] = 0.0  # 8/16 survive < 0.75 floor
+    p_skip, s_skip = fn(params, scx, scy, scm, key, survivors=surv)
+    assert float(s_skip.applied) == 0.0
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p_skip)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    p_ok, s_ok = fn(params, scx, scy, scm, key)
+    assert float(s_ok.applied) == 1.0
+    assert any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p_ok))
+    )
+    # the hierarchy root honors the same floor
+    pf = make_fed_round_partial(
+        model, cfg, mesh, wave_clients=C, cohort_clients=C
+    )
+    apply_fn = make_apply_partial(cfg, C)
+    acc = pf(params, scx, scy, scm, np.int32(0), key, survivors=surv)
+    p_h, s_h = apply_fn(params, acc)
+    assert float(s_h.applied) == 0.0
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p_h)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_wave_split_with_dropouts_matches_flat():
+    """Dropout recovery composes with the r10 hierarchy: a 4-wave round
+    with casualties equals the flat round with the same survivor mask
+    within the documented wave-split tolerance (summation order only)."""
+    cfg = _cfg(secure_agg=True)
+    model = _model()
+    mesh = client_mesh(num_devices=4)
+    cx, cy, cm = _data(seed=4)
+    params = model.init(jax.random.PRNGKey(1))
+    key = jax.random.PRNGKey(8)
+    surv = np.ones(C, dtype=np.float32)
+    surv[[0, 13]] = 0.0
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+    p_flat, s_flat = make_fed_round(model, cfg, mesh, num_clients=C)(
+        params, scx, scy, scm, key, survivors=surv
+    )
+    pf = make_fed_round_partial(
+        model, cfg, mesh, wave_clients=4, cohort_clients=C
+    )
+    accum = make_accumulate_partial()
+    acc = None
+    for w in range(4):
+        sl = slice(w * 4, (w + 1) * 4)
+        wx, wy, wm = shard_client_data(
+            mesh, cx[sl], cy[sl], jnp.asarray(cm[sl])
+        )
+        part = pf(params, wx, wy, wm, np.int32(w * 4), key, survivors=surv)
+        acc = part if acc is None else accum(acc, part)
+    p_h, s_h = make_apply_partial()(params, acc)
+    for a, b in zip(jax.tree.leaves(p_flat), jax.tree.leaves(p_h)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=0
+        )
+    assert int(s_h.num_participants) == int(s_flat.num_participants)
+    assert int(s_h.dropped_clients) == int(s_flat.dropped_clients)

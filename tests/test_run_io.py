@@ -110,7 +110,11 @@ def test_async_checkpointer_roundtrip_and_cadence(tmp_path):
 
 
 def test_async_writer_error_surfaces_on_wait(tmp_path, monkeypatch):
-    """A writer-thread failure must not vanish: wait() re-raises it."""
+    """A writer-thread failure must not vanish: wait() re-raises it —
+    since r11 as the typed CheckpointWriteError (retries exhausted),
+    with the root cause in the message, on ``.original`` and chained."""
+    from qfedx_tpu.run.checkpoint import CheckpointWriteError
+
     ck = Checkpointer(tmp_path, every=1)
 
     def boom(*a, **k):
@@ -118,13 +122,50 @@ def test_async_writer_error_surfaces_on_wait(tmp_path, monkeypatch):
 
     monkeypatch.setattr(np, "savez", boom)
     ck.save_async(1, small_params())
-    with pytest.raises(OSError, match="disk full"):
+    with pytest.raises(CheckpointWriteError, match="disk full") as ei:
         ck.wait()
+    assert isinstance(ei.value.original, OSError)
+    assert ei.value.round_idx == 1
     # The error is consumed — the writer is reusable afterwards.
     monkeypatch.undo()
     ck.save_async(2, small_params())
     ck.wait()
     assert ck.latest_round() == 2
+
+
+def test_async_writer_retries_transient_failures(tmp_path, monkeypatch):
+    """One flaky write (fails twice, then the filesystem recovers) must
+    land on disk via the shared retry policy — no error surfaces."""
+    calls = {"n": 0}
+    real_savez = np.savez
+
+    def flaky(*a, **k):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("transient stall")
+        return real_savez(*a, **k)
+
+    monkeypatch.setattr(np, "savez", flaky)
+    ck = Checkpointer(tmp_path, every=1)
+    ck.save_async(1, small_params())
+    ck.wait()  # no raise
+    assert calls["n"] == 3
+    assert ck.latest_round() == 1
+
+
+def test_async_writer_injected_fault_recovers(tmp_path, monkeypatch):
+    """The checkpoint.write fault site (QFEDX_FAULTS): a ``times: 1``
+    rule fails the first attempt of round 1's write; the retry recovers
+    and the checkpoint still lands."""
+    import json
+
+    monkeypatch.setenv("QFEDX_FAULTS", json.dumps({"seed": 0, "rules": [
+        {"site": "checkpoint.write", "rounds": [1], "times": 1},
+    ]}))
+    ck = Checkpointer(tmp_path, every=1)
+    ck.save_async(1, small_params())
+    ck.wait()
+    assert ck.latest_round() == 1
 
 
 def test_async_writer_error_suppressed_on_unwind_is_returned(
@@ -141,7 +182,10 @@ def test_async_writer_error_suppressed_on_unwind_is_returned(
     monkeypatch.setattr(np, "savez", boom)
     ck.save_async(1, small_params())
     err = ck.wait(raise_errors=False)
-    assert isinstance(err, OSError)
+    from qfedx_tpu.run.checkpoint import CheckpointWriteError
+
+    assert isinstance(err, CheckpointWriteError)
+    assert isinstance(err.original, OSError)
     assert ck.wait(raise_errors=False) is None  # consumed exactly once
 
 

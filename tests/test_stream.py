@@ -29,6 +29,7 @@ import pytest
 from qfedx_tpu import obs
 from qfedx_tpu.data.stream import (
     ArrayRegistry,
+    StreamError,
     SyntheticRegistry,
     WaveStream,
     resolve_stream_depth,
@@ -195,9 +196,76 @@ def test_wave_stream_propagates_worker_errors():
                         depth=1)
     got = [next(stream), next(stream)]
     assert [g[0] for g in got] == [0, 4]
-    with pytest.raises(RuntimeError, match="registry fetch failed"):
+    # A persistent failure surfaces as the TYPED StreamError (r11) with
+    # the failing wave index and the root cause attached — and, being a
+    # RuntimeError whose message embeds the original, pre-r11 callers
+    # matching on that still work.
+    with pytest.raises(StreamError, match="registry fetch failed") as ei:
         for _ in stream:
             pass
+    assert ei.value.wave == 2
+    assert isinstance(ei.value.original, RuntimeError)
+    # close() after a failed uploader must not hang (r11 satellite)
+    import time
+
+    t0 = time.perf_counter()
+    stream.close()
+    assert time.perf_counter() - t0 < 2.0
+
+
+def test_wave_stream_retries_transient_faults_in_place():
+    """A fault-plan registry failure bounded by ``times: 1`` is
+    recovered by the uploader's retry: every wave arrives, in order,
+    with the right bytes — the consumer never learns anything failed."""
+    from qfedx_tpu.utils.faults import FaultPlan
+
+    cx, cy, cm = _data(C=16)
+    reg = ArrayRegistry(cx, cy, cm)
+    mesh = client_mesh(num_devices=4)
+    plan = FaultPlan(seed=0, rules=[
+        {"site": "registry.fetch", "waves": [1], "times": 1},
+        {"site": "ingest.h2d", "waves": [2], "times": 1},
+    ])
+    stream = WaveStream(reg, mesh, np.arange(16), wave_size=4, depth=1,
+                        fault_plan=plan, round_idx=0)
+    seen = []
+    for wave_base, (wx, wy, wm) in stream:
+        seen.append(wave_base)
+        np.testing.assert_array_equal(
+            np.asarray(wx), cx[wave_base:wave_base + 4]
+        )
+    assert seen == [0, 4, 8, 12]
+    # An UNBOUNDED rule (no times) exhausts the retry → StreamError.
+    plan2 = FaultPlan(seed=0, rules=[
+        {"site": "registry.fetch", "waves": [1]},
+    ])
+    stream2 = WaveStream(reg, mesh, np.arange(16), wave_size=4, depth=1,
+                         fault_plan=plan2, round_idx=0)
+    assert next(stream2)[0] == 0
+    with pytest.raises(StreamError, match="injected fault") as ei:
+        next(stream2)
+    assert ei.value.wave == 1
+    stream2.close()
+
+
+def test_uploader_death_without_sentinel_raises_promptly():
+    """The stranding bug (r11 satellite): an uploader that dies without
+    queuing anything — simulated by a no-op thread body — must surface
+    a StreamError within the liveness-poll window, not block forever."""
+    import time
+
+    reg = ArrayRegistry(*_data(C=16))
+    mesh = client_mesh(num_devices=4)
+    real_uploader = WaveStream._uploader
+    WaveStream._uploader = lambda self: None
+    try:
+        stream = WaveStream(reg, mesh, np.arange(16), wave_size=4, depth=1)
+    finally:
+        WaveStream._uploader = real_uploader
+    t0 = time.perf_counter()
+    with pytest.raises(StreamError, match="uploader thread died"):
+        next(stream)
+    assert time.perf_counter() - t0 < 3.0
     stream.close()
 
 
