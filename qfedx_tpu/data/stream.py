@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -56,6 +57,25 @@ class StreamError(RuntimeError):
         super().__init__(message)
         self.wave = wave
         self.original = original
+
+
+class DroppedWave:
+    """A wave the stream gave up on (r12 satellite): its fetch/H2D
+    failed past the retry deadline, or it missed the consumer-side
+    ``wave_deadline_s``. With ``on_wave_error="drop"`` the stream yields
+    this marker IN the wave's cohort position and moves on, so the
+    round completes with the wave's clients as survivor-mask dropouts
+    instead of stalling or dying (run/trainer converts the marker into
+    casualties + the secure-agg mask correction)."""
+
+    def __init__(self, wave: int, wave_base: int,
+                 error: BaseException | None = None):
+        self.wave = wave
+        self.wave_base = wave_base
+        self.error = error
+
+    def __repr__(self):  # error surfaced in logs/metrics, not repr-noise
+        return f"DroppedWave(wave={self.wave}, base={self.wave_base})"
 
 
 def resolve_stream_depth(depth: int | None = None) -> int:
@@ -174,7 +194,12 @@ class WaveStream:
     hang). ``close()`` stops a partially consumed stream and must not
     hang even after a failed uploader. ``fault_plan``/``round_idx``
     (r11): consult a ``utils.faults.FaultPlan`` for injected
-    registry/H2D errors and per-client data poisoning.
+    registry/H2D errors, per-client data poisoning, and label-flip
+    adversaries (r12). ``on_wave_error="drop"`` + ``wave_deadline_s``
+    (r12): a wave past the retry deadline — or one that HANGS past the
+    consumer-side wave deadline — is yielded as a ``DroppedWave``
+    marker in its cohort slot instead of killing the stream; the
+    trainer converts it into survivor-mask dropouts.
     """
 
     _DONE = object()
@@ -189,6 +214,8 @@ class WaveStream:
         axis: str = "clients",
         fault_plan=None,
         round_idx: int = 0,
+        on_wave_error: str = "raise",
+        wave_deadline_s: float | None = None,
     ):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -216,6 +243,29 @@ class WaveStream:
         # the round program's quarantine is exercised organically.
         self._plan = fault_plan
         self._round_idx = int(round_idx)
+        # Failure policy (r12 satellite): "raise" = a wave's exhausted
+        # retry kills the stream (typed StreamError — the r11 shape);
+        # "drop" = the wave converts into a DroppedWave marker and the
+        # stream continues with the NEXT wave, so a persistently failing
+        # registry shard costs one wave's clients (survivor-mask
+        # dropouts), not the round. wave_deadline_s additionally bounds
+        # how long the CONSUMER waits for any one wave — the defense
+        # against a fetch that hangs rather than fails (a stuck uploader
+        # thread can serve no later wave either, so under "drop" every
+        # remaining wave converts; under "raise" it is a prompt typed
+        # error instead of a silent stall).
+        if on_wave_error not in ("raise", "drop"):
+            raise ValueError(
+                f"on_wave_error={on_wave_error!r}: expected 'raise' or "
+                "'drop'"
+            )
+        self._on_wave_error = on_wave_error
+        self._wave_deadline_s = (
+            None if wave_deadline_s is None else float(wave_deadline_s)
+        )
+        if self._wave_deadline_s is not None and self._wave_deadline_s <= 0:
+            raise ValueError("wave_deadline_s must be > 0 (None disables)")
+        self._abandoned: set[int] = set()
         self.depth = resolve_stream_depth(depth)
         self._next_wave = 0
         self._closed = False
@@ -250,6 +300,20 @@ class WaveStream:
                     cx = np.asarray(cx) * pois.reshape(
                         (len(ids),) + (1,) * (np.ndim(cx) - 1)
                     )
+                # Data-level byzantine attack (r12): a label_flip
+                # client trains on y → 1−y (binary registries), so the
+                # attack flows through REAL local gradients — the
+                # robust aggregator has to beat a plausible-looking
+                # poisoned update, not a synthetic one.
+                flips = self._plan.label_flips(self._round_idx, ids)
+                if flips.any():
+                    cy = np.where(
+                        flips.reshape(
+                            (len(ids),) + (1,) * (np.ndim(cy) - 1)
+                        ),
+                        1 - np.asarray(cy),
+                        cy,
+                    )
                 self._plan.check(
                     "ingest.h2d", self._round_idx, wave, attempt=k
                 )
@@ -265,6 +329,11 @@ class WaveStream:
             out = retry_with_deadline(
                 attempt, attempts=3, base_delay_s=0.05, max_delay_s=0.5,
                 deadline_s=30.0, describe=f"wave {wave} upload",
+                # Seeded jitter (r12 satellite): concurrent uploaders
+                # (one per round/process) de-correlate their backoff
+                # schedules deterministically instead of hammering the
+                # registry in lockstep.
+                jitter_site=f"ingest/{self._round_idx}/{wave}",
             )
         except RetryExhausted as exc:
             raise StreamError(
@@ -292,7 +361,21 @@ class WaveStream:
             for wave in range(self.num_waves):
                 if self._closed:
                     break
-                item = self._upload(wave)
+                try:
+                    item = self._upload(wave)
+                except StreamError as exc:
+                    if self._on_wave_error != "drop":
+                        raise
+                    # r12: this wave is past the retry deadline — it
+                    # becomes a casualty marker in its cohort slot and
+                    # the uploader MOVES ON, so one bad registry shard
+                    # costs its clients, not the round. (Counted at
+                    # DELIVERY in __next__, not here: the consumer may
+                    # have already deadline-dropped this wave, and a
+                    # discarded stale marker must not count twice.)
+                    item = DroppedWave(
+                        wave, wave * self._wave_size, error=exc
+                    )
                 if not self._put(item):
                     return
                 obs.gauge("ingest.queue_depth", self._queue.qsize())
@@ -316,21 +399,36 @@ class WaveStream:
         if self._next_wave >= self.num_waves or self._closed:
             raise StopIteration
         if self._queue is None:
-            item = self._upload(self._next_wave)
+            # Synchronous path: the fetch runs on THIS thread, so the
+            # consumer deadline cannot preempt a hang — only the retry
+            # deadline bounds it; "drop" still converts an exhausted
+            # retry into a casualty marker.
+            try:
+                item = self._upload(self._next_wave)
+            except StreamError as exc:
+                if self._on_wave_error != "drop":
+                    raise
+                item = DroppedWave(
+                    self._next_wave,
+                    self._next_wave * self._wave_size,
+                    error=exc,
+                )
         else:
             # Bounded get + thread-liveness check: a killed uploader
             # (die-without-sentinel — e.g. interpreter teardown, or a
             # bug in the error path itself) must not strand the trainer
-            # in an unbounded queue.get.
+            # in an unbounded queue.get. wave_deadline_s additionally
+            # bounds the wait for THIS wave: a fetch that hangs (rather
+            # than fails) past it converts into a DroppedWave ("drop")
+            # or a prompt typed error ("raise").
+            t0 = time.monotonic()
             while True:
                 try:
                     item = self._queue.get(timeout=0.2)
-                    break
                 except queue.Empty:
                     if self._thread is not None and not self._thread.is_alive():
                         try:  # a final racing put may have landed
                             item = self._queue.get_nowait()
-                            break
                         except queue.Empty:
                             self._closed = True
                             raise StreamError(
@@ -338,6 +436,44 @@ class WaveStream:
                                 f"wave {self._next_wave}",
                                 wave=self._next_wave,
                             ) from None
+                    elif (
+                        self._wave_deadline_s is not None
+                        and time.monotonic() - t0 > self._wave_deadline_s
+                    ):
+                        wave = self._next_wave
+                        if self._on_wave_error == "drop":
+                            # The uploader may deliver this wave later —
+                            # remember to discard that stale item so the
+                            # wave is never BOTH dropped and computed.
+                            self._abandoned.add(wave)
+                            item = DroppedWave(
+                                wave, wave * self._wave_size,
+                                error=StreamError(
+                                    f"wave {wave} missed the "
+                                    f"{self._wave_deadline_s}s deadline",
+                                    wave=wave,
+                                ),
+                            )
+                        else:
+                            self._closed = True
+                            raise StreamError(
+                                f"wave {wave} missed the "
+                                f"{self._wave_deadline_s}s deadline",
+                                wave=wave,
+                            ) from None
+                    else:
+                        continue
+                # Discard stale deliveries of waves the deadline already
+                # declared dead (the uploader unstuck after the fact).
+                if isinstance(item, DroppedWave):
+                    if item.wave in self._abandoned and (
+                        item.wave < self._next_wave
+                    ):
+                        continue
+                elif isinstance(item, tuple):
+                    if item[0] // self._wave_size in self._abandoned:
+                        continue
+                break
             obs.gauge("ingest.queue_depth", self._queue.qsize())
             if item is self._DONE:
                 raise StopIteration
@@ -345,6 +481,13 @@ class WaveStream:
                 self._closed = True
                 raise item
         self._next_wave += 1
+        if isinstance(item, DroppedWave):
+            # Counted exactly once per DELIVERED marker, whichever path
+            # produced it (uploader retry exhaustion, sync-path retry
+            # exhaustion, or the consumer wave deadline) — a wave that
+            # both misses the deadline and later exhausts its retry
+            # yields one discarded stale marker, not a double count.
+            obs.counter("ingest.waves_dropped")
         return item
 
     def close(self) -> None:

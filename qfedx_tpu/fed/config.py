@@ -77,6 +77,32 @@ class FedConfig:
     # nearly-empty, possibly mask-dust-dominated sum into θ. 0 (the
     # default) disables the floor and keeps the pre-r11 program exactly.
     min_participation: float = 0.0
+    # Byzantine-robust aggregation rule (r12, docs/ROBUSTNESS.md):
+    #
+    # - "mean"         — weighted FedAvg; the r11 program exactly.
+    # - "clip_mean"    — each client's Δθ is L2-clipped to ``clip_bound``
+    #   BEFORE weighting and before the secure-agg mask is added, so it
+    #   composes bit-exactly with ring masks, waves, survivor masks and
+    #   DP; ``clip_bound=inf`` (the default) compiles NO clip ops and
+    #   reproduces "mean" bit-for-bit (the min_participation=0 idiom).
+    # - "trimmed_mean" / "median" — coordinate-wise robust rules (Yin et
+    #   al. 2018) over the round's effective participants, UNIFORMLY
+    #   weighted (sample-count weights would let an attacker claim
+    #   arbitrary mass). They need per-client visibility, so with
+    #   secure_agg OFF they run per-client (within each wave) AND across
+    #   per-wave RoundPartials; with secure_agg ON the pair graph is
+    #   restricted to each WAVE (masks cancel inside a wave's partial)
+    #   and the robust rule runs across wave partials only — which still
+    #   bounds what a fully-captured wave can do, at the cost of the
+    #   server seeing per-wave (never per-client) aggregates. The flat
+    #   one-program round with secure_agg + a robust rule is rejected:
+    #   it would silently degenerate to plain masked mean.
+    #
+    # QFEDX_AGG pins the choice at BUILD time (overrides this field —
+    # the bench/experiment lever, like QFEDX_FOLD_CLIENTS).
+    aggregator: str = "mean"
+    clip_bound: float = float("inf")  # L2 bound for clip_mean (∞ = elided)
+    trim_fraction: float = 0.1  # per-END trim for trimmed_mean (< 0.5)
 
     def __post_init__(self):
         if self.algorithm not in ("fedavg", "fedprox"):
@@ -93,6 +119,18 @@ class FedConfig:
             raise ValueError(
                 f"min_participation={self.min_participation} must be a "
                 "fraction in [0, 1]"
+            )
+        if self.aggregator not in ("mean", "clip_mean", "trimmed_mean",
+                                   "median"):
+            raise ValueError(f"unknown aggregator {self.aggregator!r}")
+        if not self.clip_bound > 0:
+            raise ValueError(
+                f"clip_bound={self.clip_bound} must be > 0 (inf disables)"
+            )
+        if not (0.0 <= self.trim_fraction < 0.5):
+            raise ValueError(
+                f"trim_fraction={self.trim_fraction} must be in [0, 0.5) — "
+                "trimming half or more from each end leaves nothing"
             )
         if (
             self.dp is not None

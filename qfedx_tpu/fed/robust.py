@@ -1,0 +1,144 @@
+"""Byzantine-robust aggregation rules (r12).
+
+r11 made rounds survive *crash* faults — but its only integrity check is
+``isfinite``: a malicious client sending a finite, huge, or sign-flipped
+delta still steers θ arbitrarily (one ``scale:100`` attacker outweighs
+99 honest clients under plain FedAvg). Classical robust-aggregation
+rules close the hole, and they layer onto the round program at two
+seams (``fed/round.py``):
+
+- **``clip_mean``** — a server-chosen L2 norm bound applied to each
+  client delta BEFORE weighting and before the secure-agg mask is
+  added. Purely per-client and linear-compatible, so it composes with
+  ring masks, waves, survivor masks and DP unchanged; a bound of ∞
+  compiles no ops at all and reproduces the r11 program bit-for-bit.
+  An attacker's influence is bounded by ``clip_bound`` (≈ one honest
+  update) instead of by float range.
+- **``trimmed_mean`` / ``median``** — coordinate-wise robust rules (Yin
+  et al. 2018, arXiv:1803.01498): sort each coordinate across
+  contributors, drop the extremes (``trim_fraction`` per end) or take
+  the median. They need per-contributor visibility, so they run on the
+  unmasked path per CLIENT and — hierarchically — across per-wave
+  ``RoundPartial``s, which bounds what a fully-captured wave can do
+  even when masking is on (docs/ROBUSTNESS.md threat matrix).
+
+``robust_combine`` is the one sorting-network primitive both levels
+share: contributors are a leading axis, absentees are pushed out of the
+order with NaN (``jnp.sort`` orders NaN last), and the kept range is a
+traced function of the live count so client sampling, dropouts and
+quarantines never change the compiled program.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from qfedx_tpu.utils import trees
+
+AGGREGATORS = ("mean", "clip_mean", "trimmed_mean", "median")
+ROBUST_AGGREGATORS = ("trimmed_mean", "median")
+
+
+def resolve_aggregator(cfg) -> str:
+    """The round's aggregation rule: ``QFEDX_AGG`` (BUILD time, like
+    QFEDX_FOLD_CLIENTS) overrides ``cfg.aggregator``; a typo raises
+    loudly — the wrong-defense-measured error class is the same one the
+    pin grammar exists to prevent."""
+    env = os.environ.get("QFEDX_AGG")
+    if env is None:
+        return cfg.aggregator
+    low = env.lower()
+    if low not in AGGREGATORS:
+        raise ValueError(
+            f"QFEDX_AGG={env!r}: expected one of {AGGREGATORS}"
+        )
+    return low
+
+
+def clip_update(delta, bound: float):
+    """L2-clip one client's update tree to ``bound``; returns the
+    (possibly rescaled) tree and a float32 0/1 ``was_clipped`` flag.
+
+    Scaling (not truncation) preserves the update's direction — the
+    server bounds influence, it does not censor; an honest client whose
+    norm stays under the bound passes through with factor exactly 1.0.
+    """
+    norm = trees.global_norm(delta)
+    factor = jnp.minimum(1.0, bound / jnp.maximum(norm, 1e-12))
+    return (
+        trees.tree_scale(delta, factor),
+        (factor < 1.0).astype(jnp.float32),
+    )
+
+
+def trimmed_fraction_stat(mode: str, trim_fraction: float, m):
+    """Fraction of the ``m`` live contributors the FINAL combine level
+    excluded — the ``RoundStats.trimmed_fraction`` ledger entry.
+    ``trimmed_mean`` drops ``floor(trim_fraction·m)`` per end; ``median``
+    keeps the middle one (m odd) or two (m even)."""
+    m = jnp.asarray(m, jnp.float32)
+    if mode == "median":
+        kept = jnp.where(m > 0, 2.0 - jnp.mod(m, 2.0), 0.0)
+        trimmed = m - kept
+    elif mode == "trimmed_mean":
+        trimmed = 2.0 * jnp.floor(trim_fraction * m)
+    else:
+        return jnp.zeros((), jnp.float32)
+    return trimmed / jnp.maximum(m, 1.0)
+
+
+def robust_combine(stacked, present, mode: str, trim_fraction: float):
+    """Coordinate-wise robust combine over the LEADING axis of every
+    leaf in ``stacked``.
+
+    ``stacked``: pytree whose leaves are [K, ...] — K candidate
+    contributions (client deltas, or per-wave partial means).
+    ``present``: [K] float 0/1 — which slots hold a live contributor
+    (sampled ∧ surviving ∧ finite); absentees are excluded from the
+    order, not averaged in as zeros. ``mode``: ``"trimmed_mean"`` drops
+    ``floor(trim_fraction · m)`` contributors from EACH end of every
+    coordinate's sorted order (m = live count, traced); ``"median"``
+    takes the middle element (mean of the middle two when m is even).
+
+    Returns ``(combined, m, trimmed_fraction)`` — the reduced pytree,
+    the live-contributor count, and the fraction of contributors the
+    rule excluded per coordinate (0 when m is too small to trim).
+    m = 0 yields an all-zeros combine (the caller's min-participation /
+    weight-floor machinery decides what to do with an empty round).
+    """
+    if mode not in ROBUST_AGGREGATORS:
+        raise ValueError(
+            f"robust_combine mode {mode!r} not in {ROBUST_AGGREGATORS}"
+        )
+    present = jnp.asarray(present, jnp.float32)
+    m = jnp.sum(present)
+    k_trim = jnp.floor(trim_fraction * m)
+
+    def combine_leaf(v):
+        shape = (v.shape[0],) + (1,) * (v.ndim - 1)
+        pres = present.reshape(shape)
+        idx = jnp.arange(v.shape[0], dtype=jnp.float32).reshape(shape)
+        # Absentees become NaN so jnp.sort pushes them past the live
+        # contributors; every kept index below is < m by construction,
+        # so no NaN ever enters a sum (where, not multiply — NaN·0 is
+        # NaN, the same trap the r11 quarantine documents).
+        sv = jnp.sort(jnp.where(pres > 0, v, jnp.nan), axis=0)
+        if mode == "median":
+            lo = jnp.floor((m - 1.0) / 2.0)
+            hi = jnp.floor(m / 2.0)
+            # idx < m gates the m = 0 edge: hi = 0 would select slot 0,
+            # which holds NaN when nobody is present.
+            sel = ((idx == lo) | (idx == hi)) & (idx < m)
+            coeff = (idx == lo).astype(v.dtype) + (idx == hi).astype(
+                v.dtype
+            )
+            return jnp.sum(jnp.where(sel, sv * coeff, 0), axis=0) * 0.5
+        keep = (idx >= k_trim) & (idx < m - k_trim)
+        cnt = jnp.maximum(m - 2.0 * k_trim, 1.0)
+        return jnp.sum(jnp.where(keep, sv, 0), axis=0) / cnt.astype(v.dtype)
+
+    combined = jax.tree.map(combine_leaf, stacked)
+    return combined, m, trimmed_fraction_stat(mode, trim_fraction, m)

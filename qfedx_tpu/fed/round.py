@@ -24,6 +24,15 @@ updated θ materializes on every chip with no transfer beyond the psum
 itself. Communication per round is exactly one all-reduce of |θ| floats +
 one scalar — the MB/round metric the roadmap wants tracked
 (ROADMAP.md:115) is computable in closed form from the parameter count.
+
+Since r12 the "weighted block-sum" step is an AGGREGATION RULE
+(``FedConfig.aggregator`` / ``QFEDX_AGG``, built by ``fed/robust.py``):
+``mean`` is the program above exactly; ``clip_mean`` L2-bounds each
+client's upload before the mask joins; ``trimmed_mean``/``median``
+replace the sum with a coordinate-wise robust combine — per client on
+the unmasked path, and per WAVE across ``RoundPartial``s
+(``make_apply_partials``) — the Byzantine story docs/ROBUSTNESS.md
+tells in full.
 """
 
 from __future__ import annotations
@@ -35,15 +44,34 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+import math
+
 from qfedx_tpu import obs
 from qfedx_tpu.fed.client import make_local_update, make_local_update_clients
 from qfedx_tpu.fed.config import FedConfig
 from qfedx_tpu.fed.privacy import privatize
+from qfedx_tpu.fed.robust import (
+    ROBUST_AGGREGATORS,
+    clip_update,
+    resolve_aggregator,
+    robust_combine,
+    trimmed_fraction_stat,
+)
 from qfedx_tpu.fed.sampling import participation_mask
 from qfedx_tpu.fed.secure_agg import client_mask, ring_mask
 from qfedx_tpu.models.api import Model
 from qfedx_tpu.utils import pins, trees
 from qfedx_tpu.utils.compat import shard_map
+
+# Salts folded into the replicated round key for the program's derived
+# key streams. Module-level because the server-side dropout correction
+# (run/trainer.py + secure_agg.unmatched_mask_sum) must regenerate the
+# SAME secure-agg pair keys the round program drew — a drifting salt
+# would silently break mask recovery for fetch-dead waves.
+TRAIN_KEY_SALT = 0x7A41
+DP_KEY_SALT = 0xD9
+SA_KEY_SALT = 0x5EC
+BYZ_KEY_SALT = 0xBAD
 
 
 class RoundStats(NamedTuple):
@@ -54,6 +82,9 @@ class RoundStats(NamedTuple):
     rejected_updates: jax.Array = np.float32(0.0)  # non-finite Δθ quarantined
     dropped_clients: jax.Array = np.float32(0.0)  # sampled but dropped
     applied: jax.Array = np.float32(1.0)  # 0 ⇒ round skipped (min_participation)
+    # r12 Byzantine-defense ledger (zeros under aggregator="mean"):
+    clipped_clients: jax.Array = np.float32(0.0)  # clip_mean norm hits
+    trimmed_fraction: jax.Array = np.float32(0.0)  # contributors excluded
 
 
 class RoundPartial(NamedTuple):
@@ -78,6 +109,9 @@ class RoundPartial(NamedTuple):
     # zeros on the guards-off program):
     rejected_updates: jax.Array = np.float32(0.0)
     dropped_clients: jax.Array = np.float32(0.0)
+    # r12: clients whose Δθ hit the clip_mean norm bound (additive;
+    # zero for every other aggregator).
+    clipped_clients: jax.Array = np.float32(0.0)
 
 
 def guards_enabled() -> bool:
@@ -174,6 +208,7 @@ def _make_per_device_partial(
     axis_size: int,
     guards: bool = False,
     with_survivors: bool = False,
+    with_attack: bool = False,
 ):
     """Shared per-device body of the flat AND hierarchical round programs.
 
@@ -203,7 +238,34 @@ def _make_per_device_partial(
     the corrupted upload — its secure-agg masks STAY in the sum so ring
     cancellation over the effective set still holds. Rejections and
     dropouts are counted into the partial.
+
+    ``with_attack=True`` (r12 fault harness) appends a trailing
+    ``byzantine`` [cohort, 2] input — column 0 a per-client delta
+    multiplier (1 = honest, k = ``scale:k``, −1 = ``sign_flip``),
+    column 1 a ``noise`` σ (0 = honest; > 0 replaces the delta with
+    σ·N(0, I)) — applied to each client's finished Δθ BEFORE the
+    quarantine/defense postprocess, i.e. exactly where a malicious
+    client tampers with its upload. Like the survivors input this is a
+    separate lazily-compiled program variant: fault-free callers never
+    carry the attack ops.
+
+    The AGGREGATION RULE (r12 tentpole, ``resolve_aggregator``):
+    ``clip_mean`` L2-clips each delta to ``cfg.clip_bound`` after DP
+    and before weighting/masking (bound = ∞ compiles no ops — the
+    bit-parity lever); ``trimmed_mean``/``median`` replace the weighted
+    block-sum with a coordinate-wise robust combine over the wave's
+    effective participants (uniform weights; all_gather over ``axis``
+    then sort — per-client visibility, so only on the unmasked path).
+    With secure_agg ON a robust rule instead restricts the pair graph
+    to THIS WAVE (masks cancel inside the wave's partial, so per-wave
+    partials stay individually meaningful for the cross-wave robust
+    combine in ``make_apply_partials``) — the per-wave-aggregate
+    visibility trade docs/ROBUSTNESS.md spells out.
     """
+    agg = resolve_aggregator(cfg)
+    do_clip = agg == "clip_mean" and math.isfinite(cfg.clip_bound)
+    robust = agg in ROBUST_AGGREGATORS
+    robust_per_client = robust and not cfg.secure_agg
     local_update = make_local_update(model, cfg)
     folded = fold_clients_enabled(model, cfg)
     local_update_c = (
@@ -222,7 +284,7 @@ def _make_per_device_partial(
     # sampling/local_update/dp/secure-agg/aggregate, and ``obs.span``
     # (QFEDX_TRACE-gated, trace-time only — this function runs under
     # jit) records where TRACE-BUILD wall goes, once per compile.
-    def _body(params, cx, cy, cmask, wave_base, round_key, survivors):
+    def _body(params, cx, cy, cmask, wave_base, round_key, survivors, byz):
         # Local block shapes: cx [block, S, ...]; params replicated.
         # Client ids are COHORT positions: wave_base offsets this wave's
         # block into the round's global cohort.
@@ -242,16 +304,51 @@ def _make_per_device_partial(
             # compile it separately so a fault-free run never carries
             # the survivor input or its multiplies).
             eff = part * survivors if survivors is not None else part
+            if cfg.secure_agg and robust:
+                # Robust hierarchy under masking (r12): the pair graph
+                # is restricted to THIS wave's effective participants,
+                # so ring masks cancel inside the wave's own partial —
+                # the cross-wave robust combine then operates on clean
+                # per-wave aggregates instead of mask-corrupted ones.
+                ids_all = jnp.arange(num_clients)
+                in_wave = (
+                    (ids_all >= wave_base)
+                    & (ids_all < wave_base + wave_clients)
+                ).astype(jnp.float32)
+                sa_part = eff * in_wave
+            else:
+                sa_part = eff
 
-        train_key = jax.random.fold_in(round_key, 0x7A41)
-        dp_key = jax.random.fold_in(round_key, 0xD9)
-        sa_key = jax.random.fold_in(round_key, 0x5EC)
+        train_key = jax.random.fold_in(round_key, TRAIN_KEY_SALT)
+        dp_key = jax.random.fold_in(round_key, DP_KEY_SALT)
+        sa_key = jax.random.fold_in(round_key, SA_KEY_SALT)
+        byz_key = jax.random.fold_in(round_key, BYZ_KEY_SALT)
 
         def postprocess(cid, delta, n, loss):
-            """Quarantine/privacy/masking/weighting of ONE client's
-            finished update — shared verbatim between the folded and
-            vmap paths (always vmapped: param-sized trees, no slab
-            states)."""
+            """Attack-injection/quarantine/privacy/defense/masking/
+            weighting of ONE client's finished update — shared verbatim
+            between the folded and vmap paths (always vmapped:
+            param-sized trees, no slab states)."""
+            if with_attack:
+                # The adversary tampers AFTER local training and BEFORE
+                # upload — the server-side quarantine and defenses below
+                # must catch the result, not be spared it.
+                with jax.named_scope("byzantine_attack"):
+                    mult = byz[cid, 0]
+                    sigma = byz[cid, 1]
+                    delta = jax.tree.map(
+                        lambda d: (d * mult).astype(d.dtype), delta
+                    )
+                    rnd = trees.tree_random_normal(
+                        jax.random.fold_in(byz_key, cid), delta
+                    )
+                    delta = jax.tree.map(
+                        lambda d, r: jnp.where(
+                            sigma > 0, (sigma * r).astype(d.dtype), d
+                        ),
+                        delta,
+                        rnd,
+                    )
             if guards:
                 # Non-finite quarantine BEFORE anything consumes Δθ: a
                 # NaN/Inf update is zeroed here (where, not multiply —
@@ -284,33 +381,60 @@ def _make_per_device_partial(
                 # and skew the calibrated per-client noise share
                 # (FedConfig rejects dp_uniform_weights=False with DP).
                 weight = jnp.minimum(n, 1.0)
+            elif robust:
+                # Robust rules aggregate UNIFORMLY over effective
+                # participants: sample-count weights would let an
+                # attacker claim arbitrary mass, and the sorted-order
+                # rules have no notion of a fractional contributor.
+                weight = jnp.minimum(n, 1.0)
             else:
                 weight = n
+            aux = {}
+            if do_clip:
+                # The server's L2 norm bound on the UPLOAD (r12): after
+                # DP (clipping a privatized delta is post-processing —
+                # the guarantee is untouched), before weighting and
+                # before the secure-agg mask joins, so the bound
+                # composes bit-exactly with masks, waves and survivor
+                # recovery. bound = ∞ compiles this block away entirely
+                # (do_clip is build-time) — the mean-parity lever.
+                with jax.named_scope("byzantine_clip"):
+                    delta, was_clipped = clip_update(delta, cfg.clip_bound)
             weight = weight * eff[cid]
             if guards:
                 weight = weight * finf
+            if do_clip:
+                # Count norm-bound hits among clients that actually
+                # contribute (weight > 0 ⇔ sampled ∧ surviving ∧ finite
+                # ∧ has data) — the exact ledger the chaos tests
+                # reconcile against the fault plan.
+                aux["clipped"] = was_clipped * (weight > 0).astype(
+                    jnp.float32
+                )
+            if guards:
+                aux["finf"] = finf
             contrib = trees.tree_scale(delta, weight)
             if cfg.secure_agg:
                 with jax.named_scope("secure_agg_mask"):
-                    # Pair graph over ``eff``: a QUARANTINED client's
-                    # masks stay in the sum (finf does not gate them) —
-                    # they are deterministic PRG regenerations, not part
-                    # of the corrupted upload, so including them keeps
-                    # ring cancellation exact while its data term is 0.
+                    # Pair graph over ``sa_part`` (= ``eff``, or its
+                    # wave restriction under a robust rule): a
+                    # QUARANTINED client's masks stay in the sum (finf
+                    # does not gate them) — they are deterministic PRG
+                    # regenerations, not part of the corrupted upload,
+                    # so including them keeps ring cancellation exact
+                    # while its data term is 0.
                     if cfg.secure_agg_mode == "ring":
                         mask = ring_mask(
-                            sa_key, cid, num_clients, delta, eff,
+                            sa_key, cid, num_clients, delta, sa_part,
                             cfg.secure_agg_scale, cfg.secure_agg_neighbors,
                         )
                     else:
                         mask = client_mask(
-                            sa_key, cid, num_clients, delta, eff,
+                            sa_key, cid, num_clients, delta, sa_part,
                             cfg.secure_agg_scale,
                         )
                     contrib = trees.tree_add(contrib, mask)
-            if guards:
-                return contrib, weight, loss, finf
-            return contrib, weight, loss
+            return contrib, weight, loss, aux
 
         if folded:
             # Client axis folded into the engine batch: the whole block's
@@ -345,18 +469,42 @@ def _make_per_device_partial(
                 "fed.trace.local_update", path="vmap"
             ), jax.named_scope("local_update"):
                 outs = jax.vmap(run_client)(client_ids, cx, cy, cmask)
-        if guards:
-            contribs, weights, losses, fins = outs
-        else:
-            contribs, weights, losses = outs
+        contribs, weights, losses, aux = outs
+        fins = aux.get("finf")
 
         # Reduce the local client block, then all-reduce across chips —
-        # the per-chip partial aggregate of the hierarchy.
+        # the per-chip partial aggregate of the hierarchy. A robust rule
+        # on the unmasked path replaces the weighted sum with a
+        # coordinate-wise combine over the WAVE's gathered client
+        # deltas (uniform {0,1} weights select the live contributors);
+        # ``update_sum = combine · m`` keeps ``_finalize_partial``'s
+        # ``Σ wΔ / Σ w`` contract intact, so min_participation, stats
+        # and the hierarchy apply unchanged.
         with obs.span("fed.trace.aggregate"), jax.named_scope("aggregate"):
-            block_sum = jax.tree.map(lambda t: jnp.sum(t, axis=0), contribs)
-            update_sum = jax.lax.psum(block_sum, axis)
-            weight_sum = jax.lax.psum(jnp.sum(weights), axis)
+            if robust_per_client:
+                all_c = jax.tree.map(
+                    lambda t: jax.lax.all_gather(t, axis, tiled=True),
+                    contribs,
+                )
+                all_w = jax.lax.all_gather(weights, axis, tiled=True)
+                combined, m_eff, _tf = robust_combine(
+                    all_c, (all_w > 0).astype(jnp.float32), agg,
+                    cfg.trim_fraction,
+                )
+                update_sum = jax.tree.map(lambda t: t * m_eff, combined)
+                weight_sum = m_eff
+            else:
+                block_sum = jax.tree.map(
+                    lambda t: jnp.sum(t, axis=0), contribs
+                )
+                update_sum = jax.lax.psum(block_sum, axis)
+                weight_sum = jax.lax.psum(jnp.sum(weights), axis)
             loss_sum = jax.lax.psum(jnp.sum(weights * losses), axis)
+            clipped = (
+                jax.lax.psum(jnp.sum(aux["clipped"]), axis)
+                if do_clip
+                else jnp.zeros((), jnp.float32)
+            )
             if guards:
                 eff_ids = eff[client_ids]
                 n_part = jax.lax.psum(jnp.sum(eff_ids * fins), axis)
@@ -381,29 +529,56 @@ def _make_per_device_partial(
             num_participants=n_part,
             rejected_updates=rejected,
             dropped_clients=dropped,
+            clipped_clients=clipped,
         )
 
-    if guards and with_survivors:
+    # One wrapper per input combination — shard_map needs a positional
+    # signature matching its in_specs, and each combination is its own
+    # lazily-compiled program so fault-free callers never carry unused
+    # inputs (the r11 two-program seam, now a 2×2).
+    surv = guards and with_survivors
+    if surv and with_attack:
+
+        def per_device_partial(
+            params, cx, cy, cmask, wave_base, round_key, survivors, byz
+        ):
+            return _body(
+                params, cx, cy, cmask, wave_base, round_key, survivors, byz
+            )
+
+    elif surv:
 
         def per_device_partial(
             params, cx, cy, cmask, wave_base, round_key, survivors
         ):
             return _body(
-                params, cx, cy, cmask, wave_base, round_key, survivors
+                params, cx, cy, cmask, wave_base, round_key, survivors, None
+            )
+
+    elif with_attack:
+
+        def per_device_partial(
+            params, cx, cy, cmask, wave_base, round_key, byz
+        ):
+            return _body(
+                params, cx, cy, cmask, wave_base, round_key, None, byz
             )
 
     else:
 
         def per_device_partial(params, cx, cy, cmask, wave_base, round_key):
             return _body(
-                params, cx, cy, cmask, wave_base, round_key, None
+                params, cx, cy, cmask, wave_base, round_key, None, None
             )
 
     return per_device_partial
 
 
 def _finalize_partial(
-    params, partial: RoundPartial, min_participants: float = 0.0
+    params,
+    partial: RoundPartial,
+    min_participants: float = 0.0,
+    trimmed_fraction=None,
 ):
     """θ_new = θ + Σ wΔ / Σ w — the hierarchy's root combine, shared
     verbatim between the flat round (inline) and ``make_apply_partial``
@@ -444,6 +619,12 @@ def _finalize_partial(
         rejected_updates=partial.rejected_updates,
         dropped_clients=partial.dropped_clients,
         applied=applied,
+        clipped_clients=partial.clipped_clients,
+        trimmed_fraction=(
+            jnp.zeros((), jnp.float32)
+            if trimmed_fraction is None
+            else trimmed_fraction
+        ),
     )
     return new_params, stats
 
@@ -475,32 +656,91 @@ def make_fed_round(
     secure-agg pair graph (dropout-resilient aggregation, r11 —
     see ``_make_per_device_partial``). Guards off builds the exact
     pre-r11 program with no survivors input — the bit-parity lever.
+
+    ``byzantine`` (r12 fault harness, guards-independent): an optional
+    [num_clients, 2] float32 array of per-client (delta multiplier,
+    noise σ) attack coordinates — ``utils.faults.FaultPlan``'s
+    ``byzantine_multipliers``/``byzantine_noise`` stacked; honest
+    clients carry (1, 0). Like survivors it selects a separate
+    lazily-compiled program variant, so attack-free rounds never carry
+    the tamper ops. The DEFENSE is ``cfg.aggregator`` (r12 tentpole):
+    a robust rule (``trimmed_mean``/``median``) with ``secure_agg`` is
+    rejected HERE — the flat one-program round has no wave hierarchy,
+    so masking would silently reduce the rule to plain masked mean;
+    use the streamed hierarchical path (≥ 2 waves) or drop the masks.
     """
     guards = guards_enabled()
+    agg = resolve_aggregator(cfg)
+    if agg in ROBUST_AGGREGATORS and cfg.secure_agg:
+        raise ValueError(
+            f"aggregator={agg!r} needs per-client visibility, which "
+            "secure_agg masks remove on the flat one-program round — "
+            "it would silently degenerate to plain masked mean. Use "
+            "the hierarchical streamed path (>= 2 waves, per-wave pair "
+            "graphs) or secure_agg=False; clip_mean composes with "
+            "masking on any path."
+        )
     min_count = cfg.min_participation * num_clients
     donate_argnums = (0,) if donate else ()
 
-    def build(with_survivors: bool):
+    def build(with_survivors: bool, with_attack: bool):
         per_partial = _make_per_device_partial(
             model, cfg, num_clients, num_clients, axis, mesh.shape[axis],
             guards=guards, with_survivors=with_survivors,
+            with_attack=with_attack,
         )
-        if with_survivors:
+
+        def finalize(params, partial):
+            with jax.named_scope("aggregate"):
+                # weight_sum, not num_participants: on the flat robust
+                # path (always per-client — robust+SA is rejected
+                # above) weight_sum IS the combine's live-contributor
+                # count m (uniform 0/1 weights), while num_participants
+                # also counts effective clients with zero real samples
+                # that the combine excluded — the ledger must report
+                # what was actually trimmed.
+                tf = (
+                    trimmed_fraction_stat(
+                        agg, cfg.trim_fraction, partial.weight_sum
+                    )
+                    if agg in ROBUST_AGGREGATORS
+                    else None
+                )
+                return _finalize_partial(
+                    params, partial, min_count, trimmed_fraction=tf
+                )
+
+        if with_survivors and with_attack:
+
+            def per_device(params, cx, cy, cmask, round_key, survivors,
+                           byz):
+                return finalize(params, per_partial(
+                    params, cx, cy, cmask, 0, round_key, survivors, byz
+                ))
+
+            specs = (P(), P(axis), P(axis), P(axis), P(), P(), P())
+        elif with_survivors:
 
             def per_device(params, cx, cy, cmask, round_key, survivors):
-                partial = per_partial(
+                return finalize(params, per_partial(
                     params, cx, cy, cmask, 0, round_key, survivors
-                )
-                with jax.named_scope("aggregate"):
-                    return _finalize_partial(params, partial, min_count)
+                ))
+
+            specs = (P(), P(axis), P(axis), P(axis), P(), P())
+        elif with_attack:
+
+            def per_device(params, cx, cy, cmask, round_key, byz):
+                return finalize(params, per_partial(
+                    params, cx, cy, cmask, 0, round_key, byz
+                ))
 
             specs = (P(), P(axis), P(axis), P(axis), P(), P())
         else:
 
             def per_device(params, cx, cy, cmask, round_key):
-                partial = per_partial(params, cx, cy, cmask, 0, round_key)
-                with jax.named_scope("aggregate"):
-                    return _finalize_partial(params, partial, min_count)
+                return finalize(params, per_partial(
+                    params, cx, cy, cmask, 0, round_key
+                ))
 
             specs = (P(), P(axis), P(axis), P(axis), P())
         sharded = shard_map(
@@ -509,35 +749,44 @@ def make_fed_round(
         )
         return jax.jit(sharded, donate_argnums=donate_argnums)
 
-    jitted = build(with_survivors=False)
-    if not guards:
-        # Uniform signature either way: survivors=None is accepted (and
-        # ignored — there is nothing to apply) so call sites need no
-        # guards-conditional branching; an ACTUAL survivor mask against
-        # the unguarded program is a loud error, not a silent drop.
-        def round_fn(params, cx, cy, cmask, round_key, survivors=None):
-            if survivors is not None:
+    # The 2×2 variant seam (r11's two-program design, one axis wider):
+    # the plain variant is built eagerly (every fault-free caller);
+    # survivors/attack variants build+compile lazily on the first call
+    # that actually carries casualties or an adversary.
+    variants: dict = {(False, False): build(False, False)}
+
+    def get_variant(ws: bool, wa: bool):
+        key = (ws, wa)
+        if key not in variants:
+            variants[key] = build(ws, wa)
+        return variants[key]
+
+    def round_fn(params, cx, cy, cmask, round_key, survivors=None,
+                 byzantine=None):
+        # Uniform signature whatever the pins: survivors=None is
+        # accepted everywhere (no caller branching), while an ACTUAL
+        # survivor mask against the unguarded program is a loud error,
+        # not a silent drop.
+        if survivors is not None and not guards:
+            raise ValueError(
+                "survivors requires the guarded round program "
+                "(QFEDX_GUARDS=off built the pre-r11 program, which "
+                "has no survivor input)"
+            )
+        args = [params, cx, cy, cmask, round_key]
+        if survivors is not None:
+            args.append(jnp.asarray(survivors, jnp.float32))
+        if byzantine is not None:
+            byzantine = jnp.asarray(byzantine, jnp.float32)
+            if byzantine.shape != (num_clients, 2):
                 raise ValueError(
-                    "survivors requires the guarded round program "
-                    "(QFEDX_GUARDS=off built the pre-r11 program, which "
-                    "has no survivor input)"
+                    f"byzantine must be [num_clients={num_clients}, 2] "
+                    "(multiplier, noise sigma) per cohort client; got "
+                    f"shape {byzantine.shape}"
                 )
-            return jitted(params, cx, cy, cmask, round_key)
-
-        return round_fn
-    # Two programs, one seam: the no-survivors variant carries the
-    # quarantine but no survivor input (every fault-free caller — and
-    # every pre-r11 call site — pays for nothing new), while the
-    # survivors variant traces/compiles lazily on the first call that
-    # actually has casualties.
-    jitted_s = build(with_survivors=True)
-
-    def round_fn(params, cx, cy, cmask, round_key, survivors=None):
-        if survivors is None:
-            return jitted(params, cx, cy, cmask, round_key)
-        return jitted_s(
-            params, cx, cy, cmask, round_key,
-            jnp.asarray(survivors, jnp.float32),
+            args.append(byzantine)
+        return get_variant(survivors is not None, byzantine is not None)(
+            *args
         )
 
     return round_fn
@@ -573,17 +822,44 @@ def make_fed_round_partial(
     hierarchy: a casualty's ring partners in OTHER waves draw the same
     effective pair graph and cancellation survives the wave split
     (pinned in tests/test_robust_round.py).
+
+    ``byzantine`` (r12): optional [cohort, 2] (multiplier, noise σ)
+    attack coordinates, cohort-wide like survivors — see
+    ``make_fed_round``. A robust aggregator (``trimmed_mean``/
+    ``median``) changes what a wave's partial IS: without masks, the
+    coordinate-wise robust combine over the wave's clients; with masks,
+    the mean under a WAVE-restricted pair graph — either way the
+    partial feeds ``make_apply_partials``' cross-wave robust combine
+    instead of the additive ``make_accumulate_partial`` path.
     """
     cohort = wave_clients if cohort_clients is None else cohort_clients
     guards = guards_enabled()
+    if (
+        resolve_aggregator(cfg) in ROBUST_AGGREGATORS
+        and cfg.secure_agg
+        and wave_clients >= cohort
+    ):
+        # Same contract as make_fed_round: one wave spanning the whole
+        # cohort has no cross-wave level for the robust combine to
+        # defend at, and the wave-restricted pair graph equals the
+        # cohort graph — the rule would silently be plain masked mean.
+        raise ValueError(
+            f"aggregator={resolve_aggregator(cfg)!r} under secure_agg "
+            "defends at the WAVE level and needs wave_clients < "
+            f"cohort_clients (got wave={wave_clients}, cohort={cohort}) "
+            "— split the cohort or use clip_mean"
+        )
 
-    def build(with_survivors: bool):
+    def build(with_survivors: bool, with_attack: bool):
         per_partial = _make_per_device_partial(
             model, cfg, wave_clients, cohort, axis, mesh.shape[axis],
             guards=guards, with_survivors=with_survivors,
+            with_attack=with_attack,
         )
         specs = (P(), P(axis), P(axis), P(axis), P(), P())
         if with_survivors:
+            specs = specs + (P(),)
+        if with_attack:
             specs = specs + (P(),)
         sharded = shard_map(
             per_partial, mesh=mesh, in_specs=specs, out_specs=P(),
@@ -591,35 +867,41 @@ def make_fed_round_partial(
         )
         return jax.jit(sharded)
 
-    jitted = build(with_survivors=False)
-    if not guards:
-        # Uniform signature (see make_fed_round): survivors=None is
-        # accepted, a real mask against the unguarded program raises.
-        def partial_fn(
-            params, cx, cy, cmask, wave_base, round_key, survivors=None
-        ):
-            if survivors is not None:
-                raise ValueError(
-                    "survivors requires the guarded round program "
-                    "(QFEDX_GUARDS=off built the pre-r11 program, which "
-                    "has no survivor input)"
-                )
-            return jitted(params, cx, cy, cmask, wave_base, round_key)
+    # Same lazily-built variant seam as make_fed_round: fault-free waves
+    # run the plain program; survivors/attack variants compile only when
+    # a round actually has casualties or an adversary.
+    variants: dict = {(False, False): build(False, False)}
 
-        return partial_fn
-    # Same two-program seam as make_fed_round: fault-free waves run the
-    # no-survivors program; the survivors variant compiles only when a
-    # round actually has casualties.
-    jitted_s = build(with_survivors=True)
+    def get_variant(ws: bool, wa: bool):
+        key = (ws, wa)
+        if key not in variants:
+            variants[key] = build(ws, wa)
+        return variants[key]
 
     def partial_fn(
-        params, cx, cy, cmask, wave_base, round_key, survivors=None
+        params, cx, cy, cmask, wave_base, round_key, survivors=None,
+        byzantine=None,
     ):
-        if survivors is None:
-            return jitted(params, cx, cy, cmask, wave_base, round_key)
-        return jitted_s(
-            params, cx, cy, cmask, wave_base, round_key,
-            jnp.asarray(survivors, jnp.float32),
+        if survivors is not None and not guards:
+            raise ValueError(
+                "survivors requires the guarded round program "
+                "(QFEDX_GUARDS=off built the pre-r11 program, which "
+                "has no survivor input)"
+            )
+        args = [params, cx, cy, cmask, wave_base, round_key]
+        if survivors is not None:
+            args.append(jnp.asarray(survivors, jnp.float32))
+        if byzantine is not None:
+            byzantine = jnp.asarray(byzantine, jnp.float32)
+            if byzantine.shape != (cohort, 2):
+                raise ValueError(
+                    f"byzantine must be [cohort={cohort}, 2] "
+                    "(multiplier, noise sigma) per cohort client; got "
+                    f"shape {byzantine.shape}"
+                )
+            args.append(byzantine)
+        return get_variant(survivors is not None, byzantine is not None)(
+            *args
         )
 
     return partial_fn
@@ -663,6 +945,82 @@ def make_apply_partial(
             return _finalize_partial(params, partial, min_count)
 
     return jax.jit(apply_fn)
+
+
+def make_apply_partials(
+    cfg: FedConfig | None = None, cohort_clients: int = 0
+):
+    """Jitted ``apply_fn(params, stacked) -> (params, stats)`` over a
+    STACKED ``RoundPartial`` (every leaf carries a leading wave axis W)
+    — the hierarchy's root when the aggregation rule is non-additive.
+
+    Under ``mean``/``clip_mean`` this reduces to sum-over-waves +
+    ``_finalize_partial`` — exactly ``make_accumulate_partial`` folded
+    into the apply, kept so one call site serves every rule. Under
+    ``trimmed_mean``/``median`` (r12) the waves are combined
+    COORDINATE-WISE: each wave's mean delta (``update_sum / weight_sum``)
+    is one contributor, zero-weight waves are excluded from the order,
+    and the robust rule trims/medians ACROSS waves — so a fully
+    adversary-captured wave moves θ no further than the trim allows,
+    even when secure-agg masking hides its per-client structure
+    (the wave-restricted pair graphs of ``_make_per_device_partial``
+    keep each wave's partial mask-free in aggregate). Waves dropped by
+    the ingestion deadline simply never enter the stack. Stats sum over
+    waves; ``min_participation`` applies at the cohort root;
+    ``stats.trimmed_fraction`` reports the cross-wave combine's
+    exclusion rate.
+    """
+    agg = resolve_aggregator(cfg) if cfg is not None else "mean"
+    min_count = (
+        cfg.min_participation * cohort_clients if cfg is not None else 0.0
+    )
+    robust = agg in ROBUST_AGGREGATORS
+
+    def apply_fn(params, stacked: RoundPartial):
+        with jax.named_scope("aggregate"):
+            if not robust:
+                partial = jax.tree.map(
+                    lambda t: jnp.sum(t, axis=0), stacked
+                )
+                return _finalize_partial(params, partial, min_count)
+            w = stacked.weight_sum  # [W]
+            present = (w > 0).astype(jnp.float32)
+            wave_means = jax.tree.map(
+                lambda u: u
+                / jnp.maximum(
+                    w.reshape((-1,) + (1,) * (u.ndim - 1)), 1e-12
+                ).astype(u.dtype),
+                stacked.update_sum,
+            )
+            combined, _m_w, tf = robust_combine(
+                wave_means, present, agg, cfg.trim_fraction
+            )
+            total_w = jnp.sum(w)
+            # update_sum = combined · Σw keeps _finalize's Σ wΔ / Σ w
+            # contract: the applied update IS the cross-wave combine.
+            partial = RoundPartial(
+                update_sum=jax.tree.map(lambda t: t * total_w, combined),
+                weight_sum=total_w,
+                loss_sum=jnp.sum(stacked.loss_sum),
+                num_participants=jnp.sum(stacked.num_participants),
+                rejected_updates=jnp.sum(stacked.rejected_updates),
+                dropped_clients=jnp.sum(stacked.dropped_clients),
+                clipped_clients=jnp.sum(stacked.clipped_clients),
+            )
+            return _finalize_partial(
+                params, partial, min_count, trimmed_fraction=tf
+            )
+
+    return jax.jit(apply_fn)
+
+
+def stack_partials(parts):
+    """Host helper: a list of per-wave ``RoundPartial``s → ONE stacked
+    partial (leading wave axis per leaf) for ``make_apply_partials``.
+    Dropped waves are simply absent from the list."""
+    if not parts:
+        raise ValueError("stack_partials needs at least one wave partial")
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *parts)
 
 
 def make_fed_rounds(

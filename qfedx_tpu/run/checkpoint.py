@@ -155,6 +155,7 @@ class Checkpointer:
                             attempt, attempts=3, base_delay_s=0.05,
                             max_delay_s=0.5, deadline_s=60.0,
                             describe=f"checkpoint write (round {round_idx})",
+                            jitter_site=f"checkpoint/{round_idx}",
                         )
                     except RetryExhausted as exc:
                         raise CheckpointWriteError(

@@ -93,6 +93,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pair graph: k-successor ring (O(k)/client) or complete (O(C)/client)")
     t.add_argument("--secure-agg-neighbors", type=int, default=1,
                    help="ring hops k; unmasking a client needs its 2k neighbors to collude")
+    t.add_argument("--aggregator", default="mean",
+                   choices=["mean", "clip_mean", "trimmed_mean", "median"],
+                   help="Byzantine-robust aggregation rule (r12, "
+                        "docs/ROBUSTNESS.md); mean = defense off, the "
+                        "pre-r12 program bit-for-bit")
+    t.add_argument("--clip-bound", type=float, default=float("inf"),
+                   help="clip_mean L2 norm bound per client update "
+                        "(inf compiles no clip ops)")
+    t.add_argument("--trim-fraction", type=float, default=0.1,
+                   help="trimmed_mean per-end trim fraction (< 0.5)")
     # run
     t.add_argument("--eval-every", type=int, default=1)
     t.add_argument("--rounds-per-call", type=int, default=None,
@@ -191,6 +201,9 @@ def config_from_args(a: argparse.Namespace) -> ExperimentConfig:
             secure_agg=a.secure_agg,
             secure_agg_mode=a.secure_agg_mode,
             secure_agg_neighbors=a.secure_agg_neighbors,
+            aggregator=a.aggregator,
+            clip_bound=a.clip_bound,
+            trim_fraction=a.trim_fraction,
         ),
         num_rounds=a.rounds,
         eval_every=a.eval_every,

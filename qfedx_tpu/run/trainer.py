@@ -25,6 +25,7 @@ from qfedx_tpu import obs
 from qfedx_tpu.fed.accountant import RDPAccountant
 from qfedx_tpu.fed.config import FedConfig
 from qfedx_tpu.fed.evaluate import make_evaluator
+from qfedx_tpu.fed.robust import ROBUST_AGGREGATORS, resolve_aggregator
 from qfedx_tpu.fed.round import (
     client_mesh,
     donate_enabled,
@@ -160,8 +161,11 @@ def train_federated(
     donating = donate_enabled()
     # Read once, next to the round builds it must agree with: with
     # guards on the round program quarantines non-finite updates and
-    # the casualty ledger below lands in metrics.jsonl.
+    # the casualty ledger below lands in metrics.jsonl. Same for the
+    # aggregation rule (r12): the metrics fields below mirror what the
+    # program was actually built to do.
     guards = guards_enabled()
+    agg = resolve_aggregator(cfg)
     round_fn = make_fed_round(
         model, cfg, mesh, num_clients=num_clients, donate=donating
     )
@@ -364,6 +368,8 @@ def train_federated(
         losses = [float(l) for l in np.ravel(np.asarray(stats_h.mean_loss))]
         rejected = np.ravel(np.asarray(stats_h.rejected_updates))
         skipped = np.ravel(np.asarray(stats_h.applied)) < 0.5
+        clipped = np.ravel(np.asarray(stats_h.clipped_clients))
+        trimmed = np.ravel(np.asarray(stats_h.trimmed_fraction))
         scan_accs = (
             None
             if accs_h is None
@@ -403,6 +409,22 @@ def train_federated(
                 if skipped[i]:
                     metrics["skipped"] = True
                     obs.counter("fed.rounds_skipped")
+            if agg != "mean":
+                # The Byzantine-defense ledger (r12): which rule built
+                # this round's program, how many uploads hit the
+                # clip_mean norm bound, what fraction the robust rule
+                # trimmed — exact, reconciled against the fault plan by
+                # the chaos tests like the r11 counts above.
+                metrics["aggregator"] = agg
+                if agg == "clip_mean":
+                    clip_i = int(round(float(clipped[i])))
+                    metrics["clipped_clients"] = clip_i
+                    if clip_i:
+                        obs.counter("fed.clipped_clients", clip_i)
+                else:
+                    metrics["trimmed_fraction"] = round(
+                        float(trimmed[i]), 4
+                    )
             if accountant is not None:
                 accountant.step(
                     q=acct_q,
@@ -655,6 +677,7 @@ def train_federated_streamed(
     checkpointer=None,
     stream_depth: int | None = None,
     fault_plan=None,
+    wave_deadline_s: float | None = None,
 ) -> TrainResult:
     """Federated training over a client REGISTRY — unbounded cohorts via
     hierarchical aggregation + streamed wave ingestion (the r10 tentpole).
@@ -707,15 +730,42 @@ def train_federated_streamed(
     (``dropped_clients``, ``rejected_updates``) and skip events land in
     metrics.jsonl; ``cfg.min_participation`` turns a catastrophic round
     into a logged skip instead of a corrupted θ.
+
+    Byzantine robustness (r12): ``cfg.aggregator`` (``QFEDX_AGG``)
+    selects the defense — ``clip_mean`` bounds each upload's L2 norm on
+    any path; ``trimmed_mean``/``median`` combine per-client within
+    each wave (masks off) and ACROSS wave partials (always), which is
+    why they require ≥ 2 waves when secure-agg is on (per-wave pair
+    graphs; docs/ROBUSTNESS.md). A fault plan's ``client.byzantine``
+    rules reach the round as a per-client attack input (scale /
+    sign_flip / noise) or through the data (label_flip, applied by the
+    WaveStream), and ``clipped_clients`` / ``trimmed_fraction`` /
+    ``aggregator`` join the metrics.jsonl ledger.
+
+    Wave-fetch deadline (r12 satellite): with guards on, a wave whose
+    fetch/H2D fails past the retry deadline — or, when
+    ``wave_deadline_s`` is set, hangs past it — converts into
+    survivor-mask DROPOUTS for that wave's clients instead of stalling
+    or killing the round: the wave is skipped, its effective clients
+    join ``dropped_clients``, and under cohort-graph secure-agg the
+    casualties' unmatched ring masks are regenerated server-side and
+    subtracted (``secure_agg.unmatched_mask_sum`` — the r11 oracle,
+    now production-consulted). Guards off keeps the r11 fail-fast
+    ``StreamError``.
     """
-    from qfedx_tpu.data.stream import WaveStream
+    from qfedx_tpu.data.stream import DroppedWave, WaveStream
     from qfedx_tpu.fed.round import (
+        SA_KEY_SALT,
+        RoundStats,
         hier_enabled,
         make_accumulate_partial,
         make_apply_partial,
+        make_apply_partials,
         make_fed_round_partial,
+        stack_partials,
     )
-    from qfedx_tpu.fed.sampling import CohortSampler
+    from qfedx_tpu.fed.sampling import CohortSampler, participation_mask
+    from qfedx_tpu.fed.secure_agg import unmatched_mask_sum
 
     if model.sv_size != 1:
         raise ValueError(
@@ -749,6 +799,15 @@ def train_federated_streamed(
             "injected casualties would corrupt θ instead of exercising "
             "the recovery path"
         )
+    agg = resolve_aggregator(cfg)
+    robust = agg in ROBUST_AGGREGATORS
+    if robust and cfg.secure_agg and num_waves < 2:
+        raise ValueError(
+            f"aggregator={agg!r} under secure_agg defends at the WAVE "
+            f"level (per-wave pair graphs) and needs >= 2 waves; with "
+            f"waves={num_waves} it would silently degenerate to plain "
+            "masked mean — split the cohort or use clip_mean"
+        )
 
     sampler = CohortSampler(
         registry_size=registry.num_clients, cohort_size=cohort_size,
@@ -759,11 +818,19 @@ def train_federated_streamed(
             model, cfg, mesh, wave_clients=wave_size,
             cohort_clients=cohort_size,
         )
-        accum_fn = make_accumulate_partial()
-        apply_fn = make_apply_partial(cfg, cohort_size)
+        if robust:
+            # Non-additive rules: per-wave partials are STACKED and
+            # combined coordinate-wise at the hierarchy root — the
+            # cross-wave trim that bounds a fully-captured wave.
+            accum_fn = apply_fn = None
+            apply_stacked_fn = make_apply_partials(cfg, cohort_size)
+        else:
+            accum_fn = make_accumulate_partial()
+            apply_fn = make_apply_partial(cfg, cohort_size)
+            apply_stacked_fn = None
         round_fn = None
     else:
-        partial_fn = accum_fn = apply_fn = None
+        partial_fn = accum_fn = apply_fn = apply_stacked_fn = None
         round_fn = make_fed_round(
             model, cfg, mesh, num_clients=cohort_size
         )
@@ -837,20 +904,31 @@ def train_federated_streamed(
         # any wave dispatches (the server learns who died; the mask is
         # cohort-wide so every wave's pair graph agrees). None (no plan
         # or no casualties) keeps the all-ones fast path — and the
-        # bit-parity with a plan-free run.
+        # bit-parity with a plan-free run. The byzantine attack input
+        # (r12) rides the same seam: None when every client is honest.
         surv = None
+        surv_np = None
+        byz = None
         if plan is not None:
-            surv_np = plan.survivors(rnd, cohort_ids)
-            if not np.all(surv_np == 1.0):
+            s_np = plan.survivors(rnd, cohort_ids)
+            if not np.all(s_np == 1.0):
                 from jax.sharding import NamedSharding, PartitionSpec
 
+                surv_np = s_np
                 surv = jax.device_put(
-                    surv_np, NamedSharding(mesh, PartitionSpec())
+                    s_np, NamedSharding(mesh, PartitionSpec())
                 )
+            byz = plan.byzantine_attack(rnd, cohort_ids)
         stream = WaveStream(
             registry, mesh, cohort_ids, wave_size, depth=stream_depth,
             fault_plan=plan, round_idx=rnd,
+            # r12 satellite: with guards on, a wave past the retry/wave
+            # deadline converts into survivor-mask dropouts (handled
+            # below) instead of a fatal StreamError.
+            on_wave_error="drop" if guards else "raise",
+            wave_deadline_s=wave_deadline_s,
         )
+        lost: list = []
         try:
             # Dispatch wall covers the whole wave fan-in: JAX's async
             # dispatch returns before compute finishes, so the host
@@ -861,19 +939,108 @@ def train_federated_streamed(
                 "round.dispatch", round=rnd + 1, waves=num_waves,
                 cohort=cohort_size,
             ) as sp_dispatch:
-                if hier:
-                    acc = None
-                    for wave_base, (wx, wy, wm) in stream:
+                acc = None
+                parts: list = []
+                stats = None
+                for item in stream:
+                    if isinstance(item, DroppedWave):
+                        lost.append(item)
+                        continue
+                    wave_base, (wx, wy, wm) = item
+                    if hier:
                         part = partial_fn(
                             params, wx, wy, wm, np.int32(wave_base),
-                            round_key, survivors=surv,
+                            round_key, survivors=surv, byzantine=byz,
                         )
-                        acc = part if acc is None else accum_fn(acc, part)
+                        if robust:
+                            parts.append(part)
+                        else:
+                            acc = (
+                                part if acc is None
+                                else accum_fn(acc, part)
+                            )
+                    else:
+                        params, stats = round_fn(
+                            params, wx, wy, wm, round_key,
+                            survivors=surv, byzantine=byz,
+                        )
+                if lost:
+                    # Fetch-dead waves become DROPOUTS (r12 satellite):
+                    # their effective clients are casualties the server
+                    # discovered too late to exclude from the pair
+                    # graphs the dispatched waves already drew — so
+                    # under cohort-graph secure-agg, regenerate the
+                    # casualties' unmatched masks and subtract them
+                    # (the r11 unmatched_mask_sum oracle, production-
+                    # consulted). Robust rules need no correction: with
+                    # masks their pair graphs are wave-local, without
+                    # masks there are no masks to recover.
+                    dead = np.zeros(cohort_size, dtype=np.float32)
+                    for dw in lost:
+                        dead[dw.wave_base:dw.wave_base + wave_size] = 1.0
+                    part_np = np.asarray(participation_mask(
+                        round_key, cohort_size, cfg.client_fraction
+                    ))
+                    surv_host = (
+                        surv_np if surv_np is not None
+                        else np.ones(cohort_size, dtype=np.float32)
+                    )
+                    eff_pre = part_np * surv_host
+                    # Casualties of a dead wave = its SAMPLED clients —
+                    # including any the fault plan had already marked
+                    # dropped: their wave never dispatched, so the
+                    # in-program dropped counter (which only sees
+                    # dispatched blocks) never counts them. eff_pre (the
+                    # survivor-masked set the dispatched waves' pair
+                    # graphs ran over) is for the mask correction below.
+                    n_lost = float((part_np * dead).sum())
+                    obs.counter("fed.dropped_waves", len(lost))
+                    if acc is not None and cfg.secure_agg:
+                        sa_key = jax.random.fold_in(
+                            round_key, SA_KEY_SALT
+                        )
+                        corr = unmatched_mask_sum(
+                            sa_key, cohort_size,
+                            trees.tree_zeros_like(params),
+                            jnp.asarray(eff_pre),
+                            jnp.asarray(eff_pre * (1.0 - dead)),
+                            cfg.secure_agg_scale,
+                            cfg.secure_agg_neighbors,
+                            cfg.secure_agg_mode,
+                        )
+                        acc = acc._replace(
+                            update_sum=trees.tree_add(
+                                acc.update_sum, corr
+                            )
+                        )
+                    if acc is not None:
+                        acc = acc._replace(
+                            dropped_clients=acc.dropped_clients + n_lost
+                        )
+                    elif parts:
+                        parts[-1] = parts[-1]._replace(
+                            dropped_clients=parts[-1].dropped_clients
+                            + n_lost
+                        )
+                if hier and robust and parts:
+                    params, stats = apply_stacked_fn(
+                        params, stack_partials(parts)
+                    )
+                elif hier and acc is not None:
                     params, stats = apply_fn(params, acc)
-                else:
-                    wave_base, (wx, wy, wm) = next(iter(stream))
-                    params, stats = round_fn(
-                        params, wx, wy, wm, round_key, survivors=surv
+                if stats is None:
+                    # EVERY wave died (or the flat round's only wave
+                    # did): θ passes through untouched — the skipped-
+                    # round shape min_participation defines, decided
+                    # host-side because there is nothing to dispatch.
+                    n_lost = n_lost if lost else 0.0
+                    stats = RoundStats(
+                        mean_loss=np.float32(0.0),
+                        total_weight=np.float32(0.0),
+                        num_participants=np.float32(0.0),
+                        rejected_updates=np.float32(0.0),
+                        dropped_clients=np.float32(n_lost),
+                        applied=np.float32(0.0),
                     )
         finally:
             stream.close()
@@ -907,9 +1074,28 @@ def train_federated_streamed(
                 obs.counter("fed.dropped_clients", n_drop)
             if n_rej:
                 obs.counter("fed.rejected_updates", n_rej)
+            if lost:
+                metrics["dropped_waves"] = len(lost)
             if float(np.asarray(stats_h.applied)) < 0.5:
                 metrics["skipped"] = True
                 obs.counter("fed.rounds_skipped")
+        if agg != "mean":
+            # Byzantine-defense ledger (r12): aggregator identity plus
+            # its per-round counters, exact — the chaos tests reconcile
+            # clipped_clients against the plan like the r11 casualty
+            # counts above.
+            metrics["aggregator"] = agg
+            if agg == "clip_mean":
+                n_clip = int(round(
+                    float(np.asarray(stats_h.clipped_clients))
+                ))
+                metrics["clipped_clients"] = n_clip
+                if n_clip:
+                    obs.counter("fed.clipped_clients", n_clip)
+            else:
+                metrics["trimmed_fraction"] = round(
+                    float(np.asarray(stats_h.trimmed_fraction)), 4
+                )
         if accountant is not None:
             # acct_q is a pure function of the SAMPLED cohort (set
             # above, before the loop) — survivor counts never enter.

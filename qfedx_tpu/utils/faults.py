@@ -23,6 +23,19 @@ test harness):
   weighted contribution and secure-agg masks vanish — fed/round),
   ``nan`` / ``inf`` (its local data is poisoned so its Δθ goes
   non-finite and the quarantine path must catch it organically).
+- ``client.byzantine`` — per-(round, client) ADVERSARIES (r12): the
+  client completes local training, then tampers. ``kind``:
+  ``scale:k`` multiplies its Δθ upload by k (the model-poisoning
+  amplification attack), ``sign_flip`` negates it (= ``scale:-1`` but
+  named for the taxonomy), ``noise`` (or ``noise:σ``, default σ=1)
+  replaces it with σ·N(0, I), and ``label_flip`` flips its LABELS
+  before training (binary 0/1 registries — y → 1−y) so the attack
+  flows through real local gradients, not a synthetic delta. The first
+  three reach the round program as a [cohort, 2] (multiplier, σ) input
+  (``byzantine_multipliers``/``byzantine_noise`` → fed/round's attack
+  variant); ``label_flip`` is applied by the WaveStream to the fetched
+  batch (``label_flips``). The DEFENSE is ``FedConfig.aggregator``
+  (clip_mean / trimmed_mean / median — docs/ROBUSTNESS.md).
 - ``registry.fetch`` — transient error raised inside the WaveStream
   uploader's fetch, before the registry is read (data/stream retries).
 - ``ingest.h2d`` — same, between host batch and ``device_put``.
@@ -74,9 +87,30 @@ SITES = (
     "ingest.h2d",
     "checkpoint.write",
     "distributed.peer",
+    # Appended (not inserted): _site_code indexes this tuple, so the
+    # hash coordinates of every pre-r12 site — and therefore every
+    # pinned plan draw — must not move.
+    "client.byzantine",
 )
 CLIENT_KINDS = ("drop", "nan", "inf")
-_ERROR_SITES = tuple(s for s in SITES if s != "client.compute")
+# Byzantine base kinds; scale REQUIRES a parameter ("scale:100"), noise
+# takes an optional σ ("noise" = σ 1.0, "noise:5" = σ 5).
+BYZANTINE_KINDS = ("scale", "sign_flip", "noise", "label_flip")
+_PER_CLIENT_SITES = ("client.compute", "client.byzantine")
+_ERROR_SITES = tuple(s for s in SITES if s not in _PER_CLIENT_SITES)
+
+
+def doc_taxonomy() -> dict[str, tuple[str, ...]]:
+    """``{site: (kind spellings...)}`` — the canonical taxonomy that
+    ``docs/ROBUSTNESS.md``'s fault-site table must mirror row for row
+    (``benchmarks/check_faults.py`` enforces both directions). Derived
+    from the literal tuples above so a new site or kind cannot ship
+    without a documentation row."""
+    kinds = {
+        "client.compute": CLIENT_KINDS,
+        "client.byzantine": ("scale:k", "sign_flip", "noise", "label_flip"),
+    }
+    return {s: kinds.get(s, ("error",)) for s in SITES}
 
 
 class FaultInjected(RuntimeError):
@@ -137,11 +171,41 @@ class _Rule:
                 f"fault rule site {self.site!r} not in {SITES}"
             )
         self.kind = spec.get("kind", "error")
+        self.kind_param: float | None = None
         if self.site == "client.compute":
             if self.kind not in CLIENT_KINDS:
                 raise ValueError(
                     f"client.compute kind {self.kind!r} not in {CLIENT_KINDS}"
                 )
+        elif self.site == "client.byzantine":
+            # Parameterized kinds: "scale:100" / "noise:5"; the base
+            # name keys the hash so two scale rules at different k
+            # still fall independent coins per rule position.
+            base, _, param = str(self.kind).partition(":")
+            if base not in BYZANTINE_KINDS:
+                raise ValueError(
+                    f"client.byzantine kind {self.kind!r}: base must be "
+                    f"one of {BYZANTINE_KINDS}"
+                )
+            if param:
+                if base not in ("scale", "noise"):
+                    raise ValueError(
+                        f"kind {base!r} takes no parameter, got "
+                        f"{self.kind!r}"
+                    )
+                self.kind_param = float(param)
+            elif base == "scale":
+                raise ValueError(
+                    "kind 'scale' needs a multiplier, e.g. 'scale:100'"
+                )
+            elif base == "noise":
+                self.kind_param = 1.0
+            if base == "scale" and self.kind_param == 0:
+                raise ValueError("scale:0 is a drop, not an attack — "
+                                 "use client.compute kind='drop'")
+            if base == "noise" and not self.kind_param > 0:
+                raise ValueError(f"noise sigma must be > 0, got {self.kind!r}")
+            self.kind = base
         elif self.kind != "error":
             raise ValueError(
                 f"{self.site} supports only kind='error', got {self.kind!r}"
@@ -151,10 +215,10 @@ class _Rule:
             None if spec.get("clients") is None
             else np.asarray(spec["clients"], dtype=np.int64)
         )
-        if self.site == "client.compute":
+        if self.site in _PER_CLIENT_SITES:
             if (self.rate is None) == (self.clients is None):
                 raise ValueError(
-                    "client.compute rule needs exactly one of "
+                    f"{self.site} rule needs exactly one of "
                     "'rate' or 'clients'"
                 )
         elif self.rate is None:
@@ -204,30 +268,50 @@ class FaultPlan:
             text = Path(text).read_text()
         return cls.from_spec(json.loads(text))
 
-    # -- client.compute casualties ------------------------------------------
+    # -- per-client sites (client.compute / client.byzantine) ----------------
 
-    def _client_hits(self, kind: str, round_idx: int, ids) -> np.ndarray:
+    def _rule_hits(self, site: str, kinds: tuple, kind: str,
+                   round_idx: int, ids):
+        """Yield ``(rule, hit_mask)`` per matching rule — the ONE
+        definition of the per-client draw (parameterized byzantine
+        kinds need the rule; plain sites OR the masks)."""
         ids = np.asarray(ids, dtype=np.int64)
-        hit = np.zeros(len(ids), dtype=bool)
         for idx, rule in enumerate(self.rules):
-            if rule.site != "client.compute" or rule.kind != kind:
+            if rule.site != site or rule.kind != kind:
                 continue
             if not rule.applies(round_idx, 0):
                 continue
             if rule.clients is not None:
-                hit |= np.isin(ids, rule.clients)
+                hit = np.isin(ids, rule.clients)
             else:
                 # Hash salted by the RULE's position (like ``check``)
                 # AND the kind index, so a drop rule and a nan rule at
                 # the same rate — or two overlapping drop rules — fall
                 # independent coin flips per client.
                 u = _uniform(
-                    self.seed + CLIENT_KINDS.index(kind)
-                    + 7919 * (idx + 1),
-                    "client.compute", round_idx, 0, ids,
+                    self.seed + kinds.index(kind) + 7919 * (idx + 1),
+                    site, round_idx, 0, ids,
                 )
-                hit |= u < float(rule.rate)
+                hit = u < float(rule.rate)
+            yield rule, hit
+
+    def _site_hits(
+        self, site: str, kinds: tuple, kind: str, round_idx: int, ids
+    ) -> np.ndarray:
+        hit = np.zeros(len(np.asarray(ids)), dtype=bool)
+        for _rule, h in self._rule_hits(site, kinds, kind, round_idx, ids):
+            hit |= h
         return hit
+
+    def _client_hits(self, kind: str, round_idx: int, ids) -> np.ndarray:
+        return self._site_hits(
+            "client.compute", CLIENT_KINDS, kind, round_idx, ids
+        )
+
+    def _byz_hits(self, kind: str, round_idx: int, ids) -> np.ndarray:
+        return self._site_hits(
+            "client.byzantine", BYZANTINE_KINDS, kind, round_idx, ids
+        )
 
     def survivors(self, round_idx: int, cohort_ids) -> np.ndarray:
         """[len(cohort_ids)] float32 0/1: 0 = this client DROPS this
@@ -254,6 +338,65 @@ class FaultPlan:
             k: int(self._client_hits(k, round_idx, cohort_ids).sum())
             for k in CLIENT_KINDS
         }
+
+    # -- client.byzantine adversaries (r12) ----------------------------------
+
+    def _byz_rule_hits(self, kind: str, round_idx: int, ids):
+        """``(rule, hit_mask)`` per matching byzantine rule —
+        parameterized kinds (scale:k, noise:σ) need the RULE, not just
+        the union; the draw itself is ``_rule_hits``, the one shared
+        definition."""
+        return self._rule_hits(
+            "client.byzantine", BYZANTINE_KINDS, kind, round_idx, ids
+        )
+
+    def byzantine_multipliers(self, round_idx: int, cohort_ids) -> np.ndarray:
+        """[len(cohort_ids)] float32 per-client Δθ multiplier: 1 =
+        honest, k where a ``scale:k`` rule fires, negated where
+        ``sign_flip`` fires (overlapping rules compose by product —
+        a scaled sign-flipper uploads −k·Δθ)."""
+        out = np.ones(len(np.asarray(cohort_ids)), dtype=np.float32)
+        for rule, hit in self._byz_rule_hits("scale", round_idx, cohort_ids):
+            out[hit] *= np.float32(rule.kind_param)
+        for _rule, hit in self._byz_rule_hits(
+            "sign_flip", round_idx, cohort_ids
+        ):
+            out[hit] *= np.float32(-1.0)
+        return out
+
+    def byzantine_noise(self, round_idx: int, cohort_ids) -> np.ndarray:
+        """[len(cohort_ids)] float32 noise σ: 0 = honest; where a
+        ``noise``/``noise:σ`` rule fires the client's upload is replaced
+        by σ·N(0, I) (largest σ wins when rules overlap)."""
+        out = np.zeros(len(np.asarray(cohort_ids)), dtype=np.float32)
+        for rule, hit in self._byz_rule_hits("noise", round_idx, cohort_ids):
+            out[hit] = np.maximum(out[hit], np.float32(rule.kind_param))
+        return out
+
+    def label_flips(self, round_idx: int, cohort_ids) -> np.ndarray:
+        """[len(cohort_ids)] bool: clients whose LABELS flip before
+        local training (data-level attack — flows through real
+        gradients; binary-label registries, y → 1−y in data/stream)."""
+        return self._byz_hits("label_flip", round_idx, cohort_ids)
+
+    def byzantine_counts(self, round_idx: int, cohort_ids) -> dict:
+        """{kind: n} per byzantine base kind — the exact per-round
+        adversary ledger (the chaos tests reconcile ``clipped_clients``
+        in metrics.jsonl against the update-level entries)."""
+        return {
+            k: int(self._byz_hits(k, round_idx, cohort_ids).sum())
+            for k in BYZANTINE_KINDS
+        }
+
+    def byzantine_attack(self, round_idx: int, cohort_ids):
+        """The round program's attack input: [cohort, 2] float32 of
+        (multiplier, noise σ) — or None when every client is honest
+        this round (the fast path: no attack program variant traces)."""
+        mult = self.byzantine_multipliers(round_idx, cohort_ids)
+        sigma = self.byzantine_noise(round_idx, cohort_ids)
+        if np.all(mult == 1.0) and np.all(sigma == 0.0):
+            return None
+        return np.stack([mult, sigma], axis=1).astype(np.float32)
 
     # -- error sites ---------------------------------------------------------
 

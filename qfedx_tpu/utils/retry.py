@@ -13,9 +13,18 @@ hand-rolled per call site (the same consolidation argument as
 times, sleeping ``base_delay · 2^k`` (capped at ``max_delay``) between
 tries, never past ``deadline_s`` total. The attempt INDEX is passed to
 ``fn`` so callers can key deterministic fault injection
-(``utils/faults``) and logging off it. No jitter by design: the fault
-harness pins exact retry schedules, and these are single-consumer
-host threads, not a thundering herd.
+(``utils/faults``) and logging off it.
+
+Jitter is SEEDED, never random (r12 satellite): pass ``jitter_site``
+(a stable string naming the call site — e.g. ``"ingest/<round>/<wave>"``)
+and each sleep is scaled by a factor in [0.5, 1.0) hashed from
+(site, attempt). Concurrent uploader threads and processes therefore
+de-correlate their backoff schedules — no lockstep retry stampede
+against a recovering registry — while every schedule stays a pure
+function of its coordinates: reruns, resumes and the fault harness see
+identical timing, and a test can predict the exact delays
+(tests/test_faults.py). ``jitter_site=None`` (the default) keeps the
+bare exponential schedule.
 
 On exhaustion a typed ``RetryExhausted`` raises, chaining the last
 error (``__cause__``) and carrying ``attempts``/``elapsed_s`` — callers
@@ -25,8 +34,21 @@ that need the root cause for their own typed error (``StreamError``,
 
 from __future__ import annotations
 
+import hashlib
 import time
 from typing import Any, Callable, Iterable
+
+
+def jitter_factor(site: str, attempt: int) -> float:
+    """Deterministic backoff jitter in [0.5, 1.0): a pure hash of
+    (site, attempt) — no ``random``, no process state. blake2b (not
+    Python's ``hash``) because PYTHONHASHSEED randomization would make
+    schedules differ across reruns, which is exactly what the fault
+    harness must never see."""
+    digest = hashlib.blake2b(
+        f"{site}#{attempt}".encode(), digest_size=8
+    ).digest()
+    return 0.5 + 0.5 * (int.from_bytes(digest, "little") / 2.0**64)
 
 
 class RetryExhausted(RuntimeError):
@@ -54,12 +76,15 @@ def retry_with_deadline(
     retry_on: Iterable[type[BaseException]] = (Exception,),
     describe: str = "operation",
     sleep: Callable[[float], None] = time.sleep,
+    jitter_site: str | None = None,
 ) -> Any:
     """Run ``fn(attempt)``, retrying failed attempts with exponential
     backoff until success, ``attempts`` tries, or ``deadline_s`` wall —
     whichever first. Non-``retry_on`` exceptions propagate immediately
     (a KeyboardInterrupt must never be eaten by a backoff loop).
     ``sleep`` is injectable so tests pin the schedule without waiting.
+    ``jitter_site`` turns on seeded schedule jitter (module docstring):
+    delay k becomes ``min(base·2^k, max) · jitter_factor(site, k)``.
     """
     if attempts < 1:
         raise ValueError(f"attempts must be >= 1, got {attempts}")
@@ -78,6 +103,8 @@ def retry_with_deadline(
                     describe, k + 1, elapsed, last
                 ) from last
             delay = min(base_delay_s * (2.0 ** k), max_delay_s)
+            if jitter_site is not None:
+                delay *= jitter_factor(jitter_site, k)
             # Never sleep past the deadline: the next attempt must start
             # while there is still budget to fail it properly.
             delay = min(delay, max(0.0, deadline_s - elapsed))

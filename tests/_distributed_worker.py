@@ -24,7 +24,13 @@ firing peer's wave-0 client joins the survivor mask as dead. The rule
 targets peer 1, so client 1 (process 1, wave 0) dies and the surviving
 ring over {0, 2, 3} pairs client 0 with partners in the OTHER wave on
 the OTHER process — dropout-resilient mask cancellation across both
-the wave split and the process boundary.
+the wave split and the process boundary. ``byzantine`` (r12) is
+``hier`` with a ``client.byzantine`` ``scale:1000`` rule targeting
+client 1 — hosted by PROCESS 1 in wave 0 — and the ``clip_mean``
+defense on: every controller derives the same attack input from the
+plan with zero communication (``byzantine_multipliers``), the attacked
+upload is clipped inside the cross-process program, and the defended
+aggregate must match the single-process flat round bit-for-tolerance.
 """
 
 import os
@@ -71,7 +77,18 @@ def main() -> None:
     from qfedx_tpu.fed.round import make_fed_round
     from qfedx_tpu.models.vqc import make_vqc_classifier
 
-    if mode in ("hier", "dropout"):
+    if mode == "byzantine":
+        # r12: same 2-wave hier shape, attacker on process 1, clip_mean
+        # defense (composes with the cohort-wide ring graph — the
+        # robust rules' per-wave graphs are pinned single-process in
+        # tests/test_byzantine.py; here the thing under test is the
+        # defense inside REAL cross-process collectives).
+        num_clients, samples, n_q = 4, 8, 3
+        cfg = FedConfig(local_epochs=2, batch_size=4, learning_rate=0.1,
+                        optimizer="sgd", secure_agg=True,
+                        secure_agg_mode="ring", aggregator="clip_mean",
+                        clip_bound=0.5)
+    elif mode in ("hier", "dropout"):
         # 4-client cohort split into 2 waves of 2 (one client per
         # process per wave); sgd keeps the wave-split comparison
         # float-tight (tests/test_hier.py's tolerance rationale), ring
@@ -108,7 +125,7 @@ def main() -> None:
     )
     key = globalize(np.asarray(jax.random.PRNGKey(42)), P())
 
-    if mode in ("hier", "dropout"):
+    if mode in ("hier", "dropout", "byzantine"):
         from qfedx_tpu.fed.round import (
             make_accumulate_partial,
             make_apply_partial,
@@ -116,6 +133,22 @@ def main() -> None:
         )
 
         survivors = None
+        byz = None
+        if mode == "byzantine":
+            # Every controller derives the SAME attack input from the
+            # seeded plan — zero communication, like the dropout mode's
+            # survivor agreement below. The attacker (client 1) lives
+            # on process 1 in wave 0; its ×1000 upload is clipped
+            # inside the cross-process program.
+            from qfedx_tpu.utils.faults import FaultPlan
+
+            plan = FaultPlan(seed=0, rules=[{
+                "site": "client.byzantine", "kind": "scale:1000",
+                "clients": [1],
+            }])
+            byz_np = plan.byzantine_attack(0, np.arange(num_clients))
+            assert byz_np is not None and byz_np[1, 0] == 1000.0
+            byz = globalize(byz_np, P())
         if mode == "dropout":
             # The distributed.peer fault site decides the casualty:
             # every process consults check(round=0, wave=peer) for each
@@ -151,7 +184,7 @@ def main() -> None:
             wm = globalize(cm[sl], P("clients"))
             wb = globalize(np.asarray(w * wave, dtype=np.int32), P())
             part = partial_fn(params, wx, wy, wm, wb, key,
-                              survivors=survivors)
+                              survivors=survivors, byzantine=byz)
             acc = part if acc is None else accum(acc, part)
         new_params, stats = make_apply_partial()(params, acc)
     else:
@@ -170,6 +203,7 @@ def main() -> None:
         leaves["mean_loss"] = np.asarray(stats.mean_loss)
         leaves["total_weight"] = np.asarray(stats.total_weight)
         leaves["num_participants"] = np.asarray(stats.num_participants)
+        leaves["clipped_clients"] = np.asarray(stats.clipped_clients)
         np.savez(out_path, **leaves)
     print(f"worker {pid} done", flush=True)
 

@@ -1,0 +1,395 @@
+"""Byzantine-robust aggregation pins (r12 tentpole).
+
+The contracts the robust-aggregation layer stands on, in the shape of
+tests/test_robust_round.py's matrix:
+
+(a) **Defense off ≡ r11, bit for bit.** ``aggregator="mean"`` IS the
+    r11 program, and ``clip_mean`` at ``clip_bound=inf`` compiles no
+    clip ops (the ``min_participation=0`` idiom), so the two builds are
+    the SAME program — pinned bit-identical across the secure-agg × DP
+    matrix and across the wave/survivor composition.
+(b) **clip_mean bounds an attacker.** A ``scale:k`` adversary moves θ
+    under plain mean; under a finite bound its influence collapses to
+    ≈ one honest update, ``clipped_clients`` counts it exactly, and the
+    bound composes with ring masks (the mask joins AFTER the clip).
+(c) **trimmed_mean/median reject outliers per client** (masks off) —
+    the attacked robust round lands within noise of the attack-free
+    robust round while plain mean is dragged away.
+(d) **The hierarchy bounds a captured WAVE.** Robust rules combine
+    ACROSS per-wave partials (``make_apply_partials``), so a fully
+    byzantine wave is trimmed even when secure-agg masking hides its
+    per-client structure — with the pair graph restricted per wave
+    (each wave's lr=0 partial is pure mask dust on its own).
+
+Shapes tiny (3 qubits, 1 layer, 16 clients) — tier-1 budget.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qfedx_tpu.fed.config import DPConfig, FedConfig
+from qfedx_tpu.fed.robust import (
+    resolve_aggregator,
+    robust_combine,
+    trimmed_fraction_stat,
+)
+from qfedx_tpu.fed.round import (
+    client_mesh,
+    make_apply_partials,
+    make_fed_round,
+    make_fed_round_partial,
+    shard_client_data,
+    stack_partials,
+)
+from qfedx_tpu.models.vqc import make_vqc_classifier
+
+C, S, N_Q = 16, 4, 3
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    cx = rng.uniform(0, 1, (C, S, N_Q)).astype(np.float32)
+    cy = (cx.mean(axis=2) > 0.5).astype(np.int32)
+    cm = np.ones((C, S), dtype=np.float32)
+    return cx, cy, cm
+
+
+def _model():
+    return make_vqc_classifier(n_qubits=N_Q, n_layers=1, num_classes=2)
+
+
+def _cfg(**kw):
+    base = dict(local_epochs=1, batch_size=4, learning_rate=0.1,
+                optimizer="sgd", client_fraction=0.5)
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _attack(scale_clients=(), scale=100.0, noise_clients=(), sigma=1.0):
+    byz = np.zeros((C, 2), dtype=np.float32)
+    byz[:, 0] = 1.0
+    for c in scale_clients:
+        byz[c, 0] = scale
+    for c in noise_clients:
+        byz[c, 1] = sigma
+    return byz
+
+
+def _maxdiff(a_tree, b_tree):
+    return max(
+        float(jnp.max(jnp.abs(jnp.asarray(a) - jnp.asarray(b))))
+        for a, b in zip(jax.tree.leaves(a_tree), jax.tree.leaves(b_tree))
+    )
+
+
+def test_aggregator_pin_and_config_validation(monkeypatch):
+    monkeypatch.delenv("QFEDX_AGG", raising=False)
+    assert resolve_aggregator(_cfg()) == "mean"
+    assert resolve_aggregator(_cfg(aggregator="median")) == "median"
+    monkeypatch.setenv("QFEDX_AGG", "trimmed_mean")
+    assert resolve_aggregator(_cfg()) == "trimmed_mean"  # pin overrides
+    monkeypatch.setenv("QFEDX_AGG", "huber")
+    with pytest.raises(ValueError, match="QFEDX_AGG"):
+        resolve_aggregator(_cfg())
+    monkeypatch.delenv("QFEDX_AGG", raising=False)
+    with pytest.raises(ValueError, match="aggregator"):
+        FedConfig(aggregator="krum")
+    with pytest.raises(ValueError, match="clip_bound"):
+        FedConfig(clip_bound=0.0)
+    with pytest.raises(ValueError, match="trim_fraction"):
+        FedConfig(trim_fraction=0.5)
+    # flat round + robust rule + secure-agg = silent mean — rejected
+    with pytest.raises(ValueError, match="per-client visibility"):
+        make_fed_round(
+            _model(), _cfg(aggregator="median", secure_agg=True),
+            client_mesh(num_devices=4), num_clients=C,
+        )
+    # same hole at the hierarchy seam: ONE wave spanning the cohort has
+    # no cross-wave level to defend at — rejected, not degenerated
+    with pytest.raises(ValueError, match="WAVE level"):
+        make_fed_round_partial(
+            _model(), _cfg(aggregator="median", secure_agg=True),
+            client_mesh(num_devices=4), wave_clients=C,
+        )
+
+
+def test_robust_combine_matches_numpy_oracle():
+    """The sorting-network primitive against a numpy oracle, including
+    absent contributors (the traced-m machinery must trim among the
+    LIVE entries only)."""
+    rng = np.random.default_rng(3)
+    v = rng.normal(size=(8, 5)).astype(np.float32)
+    present = np.array([1, 1, 0, 1, 1, 1, 0, 1], np.float32)
+    live = v[present > 0]  # 6 contributors
+    med, m, tf = robust_combine({"x": jnp.asarray(v)}, present, "median", 0.0)
+    np.testing.assert_allclose(
+        np.asarray(med["x"]), np.median(live, axis=0), atol=1e-6
+    )
+    assert float(m) == 6.0
+    assert float(tf) == pytest.approx((6 - 2) / 6)
+    tm, m2, tf2 = robust_combine(
+        {"x": jnp.asarray(v)}, present, "trimmed_mean", 0.2
+    )
+    k = int(0.2 * 6)  # 1 per end
+    oracle = np.mean(np.sort(live, axis=0)[k:6 - k], axis=0)
+    np.testing.assert_allclose(np.asarray(tm["x"]), oracle, atol=1e-6)
+    assert float(tf2) == pytest.approx(2 * k / 6)
+    # m = 0 degenerates to zeros, not NaN
+    z, m0, _ = robust_combine(
+        {"x": jnp.asarray(v)}, np.zeros(8, np.float32), "median", 0.0
+    )
+    assert float(m0) == 0.0
+    assert np.all(np.asarray(z["x"]) == 0.0)
+    assert float(trimmed_fraction_stat("mean", 0.2, 6)) == 0.0
+
+
+# (a) mean ≡ clip_mean(∞): the clip ops are elided at build time, so
+# the two builds are the same program — bit-identical everywhere, SA
+# and adam rows included (no compile-structure caveat applies when the
+# programs are literally identical).
+PARITY = [
+    ("sgd_dp", dict(dp=DPConfig(clip_norm=1.0, noise_multiplier=0.5))),
+    ("sgd_sa", dict(secure_agg=True, secure_agg_mode="ring")),
+    ("adam_sa", dict(optimizer="adam", secure_agg=True,
+                     secure_agg_mode="ring")),
+]
+
+
+@pytest.mark.parametrize("label,kw", PARITY, ids=[p[0] for p in PARITY])
+def test_clip_inf_is_bitexact_mean(label, kw):
+    model = _model()
+    mesh = client_mesh(num_devices=4)
+    cx, cy, cm = _data()
+    params = model.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(7)
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+    p_mean, s_mean = make_fed_round(
+        model, _cfg(**kw), mesh, num_clients=C
+    )(params, scx, scy, scm, key)
+    p_clip, s_clip = make_fed_round(
+        model, _cfg(**kw, aggregator="clip_mean"), mesh, num_clients=C
+    )(params, scx, scy, scm, key)
+    for a, b in zip(jax.tree.leaves(p_mean), jax.tree.leaves(p_clip)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert float(s_clip.clipped_clients) == 0.0
+    assert float(s_clip.trimmed_fraction) == 0.0
+    assert int(s_mean.num_participants) == int(s_clip.num_participants)
+
+
+def test_clip_inf_bitexact_composes_with_waves_and_survivors():
+    """(a) across the r10/r11 composition: 2-wave hierarchical round
+    with secure-agg AND mid-round dropouts — clip_mean(∞) partials and
+    apply reproduce the mean hierarchy bit for bit."""
+    model = _model()
+    mesh = client_mesh(num_devices=4)
+    cx, cy, cm = _data(seed=3)
+    params = model.init(jax.random.PRNGKey(1))
+    key = jax.random.PRNGKey(9)
+    surv = np.ones(C, dtype=np.float32)
+    surv[[2, 11]] = 0.0
+
+    def run(agg):
+        cfg = _cfg(secure_agg=True, aggregator=agg)
+        pf = make_fed_round_partial(
+            model, cfg, mesh, wave_clients=C // 2, cohort_clients=C
+        )
+        parts = []
+        for w in range(2):
+            sl = slice(w * (C // 2), (w + 1) * (C // 2))
+            wx, wy, wm = shard_client_data(
+                mesh, cx[sl], cy[sl], jnp.asarray(cm[sl])
+            )
+            parts.append(pf(params, wx, wy, wm, np.int32(w * (C // 2)),
+                            key, survivors=surv))
+        return make_apply_partials(cfg, C)(params, stack_partials(parts))
+
+    p_mean, s_mean = run("mean")
+    p_clip, s_clip = run("clip_mean")
+    for a, b in zip(jax.tree.leaves(p_mean), jax.tree.leaves(p_clip)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert int(s_mean.dropped_clients) == int(s_clip.dropped_clients)
+    assert float(s_clip.clipped_clients) == 0.0
+
+
+def test_clip_mean_bounds_attacker_with_exact_count():
+    """(b): a scale:1000 attacker drags plain mean far from the clean
+    round; a finite bound collapses its influence to ≈ one honest
+    update and counts exactly one clipped client — with ring masks ON
+    (the clip happens before the mask joins)."""
+    model = _model()
+    mesh = client_mesh(num_devices=4)
+    cx, cy, cm = _data(seed=5)
+    params = model.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(3)
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+    byz = _attack(scale_clients=[6], scale=1000.0)
+    cfg_mean = _cfg(client_fraction=1.0, secure_agg=True)
+    fn_mean = make_fed_round(model, cfg_mean, mesh, num_clients=C)
+    p_clean, _ = fn_mean(params, scx, scy, scm, key)
+    p_att, _ = fn_mean(params, scx, scy, scm, key, byzantine=byz)
+    d_undefended = _maxdiff(p_att, p_clean)
+    fn_clip = make_fed_round(
+        model,
+        _cfg(client_fraction=1.0, secure_agg=True,
+             aggregator="clip_mean", clip_bound=0.5),
+        mesh, num_clients=C,
+    )
+    p_def, s_def = fn_clip(params, scx, scy, scm, key, byzantine=byz)
+    d_defended = _maxdiff(p_def, p_clean)
+    assert int(s_def.clipped_clients) == 1
+    assert d_undefended > 0.5, d_undefended
+    assert d_defended < 0.1, d_defended
+    assert d_defended < d_undefended / 10
+    # the attack input shape is validated loudly
+    with pytest.raises(ValueError, match="byzantine"):
+        fn_clip(params, scx, scy, scm, key,
+                byzantine=np.ones((C,), np.float32))
+
+
+@pytest.mark.parametrize("agg", ["trimmed_mean", "median"])
+def test_robust_rules_reject_scale_attack_per_client(agg):
+    """(c): masks off, the coordinate-wise rule excludes the attacker —
+    the attacked robust round stays within noise of the attack-free
+    robust round, while its distance under plain mean is large."""
+    model = _model()
+    mesh = client_mesh(num_devices=4)
+    cx, cy, cm = _data(seed=8)
+    params = model.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(6)
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+    byz = _attack(scale_clients=[4], scale=1000.0)
+    cfg = _cfg(client_fraction=1.0, aggregator=agg, trim_fraction=0.2)
+    fn = make_fed_round(model, cfg, mesh, num_clients=C)
+    p_clean, s_clean = fn(params, scx, scy, scm, key)
+    p_att, s_att = fn(params, scx, scy, scm, key, byzantine=byz)
+    assert _maxdiff(p_att, p_clean) < 0.05
+    assert float(s_att.trimmed_fraction) > 0.0
+    assert int(s_att.num_participants) == C
+    # same attack through plain mean, for scale: it must hurt
+    fn_mean = make_fed_round(
+        model, _cfg(client_fraction=1.0), mesh, num_clients=C
+    )
+    p_mean_clean, _ = fn_mean(params, scx, scy, scm, key)
+    p_mean_att, _ = fn_mean(params, scx, scy, scm, key, byzantine=byz)
+    assert _maxdiff(p_mean_att, p_mean_clean) > 0.5
+
+
+def test_hier_robust_bounds_fully_captured_wave():
+    """(d): 4 waves under ring secure-agg, wave 1 entirely byzantine
+    (scale:1000). Per-wave pair graphs keep each wave's partial clean;
+    the cross-wave trimmed mean (trim_fraction 0.25 ⇒ 1 wave per end)
+    discards the hostile wave — θ lands within noise of the clean run.
+    The additive mean hierarchy under the same attack is dragged away."""
+    model = _model()
+    mesh = client_mesh(num_devices=4)
+    cx, cy, cm = _data(seed=4)
+    params = model.init(jax.random.PRNGKey(1))
+    key = jax.random.PRNGKey(8)
+    wc = C // 4
+    byz = _attack(scale_clients=range(wc, 2 * wc), scale=1000.0)
+
+    def run(agg, attack, secure=True):
+        cfg = _cfg(client_fraction=1.0, secure_agg=secure, aggregator=agg,
+                   trim_fraction=0.25)
+        pf = make_fed_round_partial(
+            model, cfg, mesh, wave_clients=wc, cohort_clients=C
+        )
+        parts = []
+        for w in range(4):
+            sl = slice(w * wc, (w + 1) * wc)
+            wx, wy, wm = shard_client_data(
+                mesh, cx[sl], cy[sl], jnp.asarray(cm[sl])
+            )
+            parts.append(pf(params, wx, wy, wm, np.int32(w * wc), key,
+                            byzantine=attack))
+        return make_apply_partials(cfg, C)(params, stack_partials(parts))
+
+    p_clean, _ = run("trimmed_mean", None)
+    p_def, s_def = run("trimmed_mean", byz)
+    assert _maxdiff(p_def, p_clean) < 0.05
+    assert float(s_def.trimmed_fraction) == pytest.approx(0.5)  # 2/4 waves
+    p_mean_clean, _ = run("mean", None)
+    p_mean_att, _ = run("mean", byz)
+    assert _maxdiff(p_mean_att, p_mean_clean) > 0.5
+
+
+def test_robust_sa_per_wave_masks_cancel():
+    """The wave-restricted pair graph: at lr=0 EVERY wave's partial is
+    pure mask dust on its own (cohort-graph masks would only cancel in
+    the cross-wave sum — useless to a non-additive combine), and the
+    stacked robust apply leaves θ within float dust."""
+    model = _model()
+    mesh = client_mesh(num_devices=4)
+    cx, cy, cm = _data(seed=1)
+    params = model.init(jax.random.PRNGKey(2))
+    key = jax.random.PRNGKey(4)
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.0,
+                    momentum=0.0, client_fraction=1.0, secure_agg=True,
+                    aggregator="trimmed_mean", trim_fraction=0.25)
+    wc = C // 4
+    pf = make_fed_round_partial(
+        model, cfg, mesh, wave_clients=wc, cohort_clients=C
+    )
+    parts = []
+    for w in range(4):
+        sl = slice(w * wc, (w + 1) * wc)
+        wx, wy, wm = shard_client_data(
+            mesh, cx[sl], cy[sl], jnp.asarray(cm[sl])
+        )
+        part = pf(params, wx, wy, wm, np.int32(w * wc), key)
+        residual = max(
+            float(jnp.max(jnp.abs(leaf)))
+            for leaf in jax.tree.leaves(part.update_sum)
+        )
+        assert residual < 1e-5, f"wave {w} masks left {residual}"
+        parts.append(part)
+    p_new, stats = make_apply_partials(cfg, C)(
+        params, stack_partials(parts)
+    )
+    assert _maxdiff(p_new, params) < 1e-5
+    assert int(stats.num_participants) == C
+
+
+def test_streamed_robust_defends_against_plan(tmp_path):
+    """End-to-end through the streamed trainer: a client.byzantine plan
+    (scale + label_flip attackers) under trimmed_mean + ring SA over 2
+    waves completes, reports the aggregator ledger in metrics.jsonl
+    rows, and keeps θ finite."""
+    from qfedx_tpu.data.stream import ArrayRegistry
+    from qfedx_tpu.run.trainer import train_federated_streamed
+    from qfedx_tpu.utils.faults import FaultPlan
+
+    cx, cy, cm = _data(seed=6)
+    tx, ty = cx[:, 0, :], cy[:, 0]
+    model = _model()
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1,
+                    secure_agg=True, aggregator="trimmed_mean",
+                    trim_fraction=0.3)
+    plan = FaultPlan(seed=2, rules=[
+        {"site": "client.byzantine", "kind": "scale:1000", "clients": [3]},
+        {"site": "client.byzantine", "kind": "label_flip", "clients": [9]},
+    ])
+    rows = []
+    res = train_federated_streamed(
+        model, cfg, ArrayRegistry(cx, cy, cm), tx, ty,
+        cohort_size=C, wave_size=C // 4, num_rounds=2, seed=1,
+        eval_every=3, mesh=client_mesh(num_devices=4), fault_plan=plan,
+        on_round_end=lambda r, m: rows.append(m),
+    )
+    for leaf in jax.tree.leaves(res.params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+    assert len(rows) == 2
+    for row in rows:
+        assert row["aggregator"] == "trimmed_mean"
+        # final combine = across 4 waves at trim 0.3 ⇒ 1 per end ⇒ 2/4
+        assert row["trimmed_fraction"] == pytest.approx(0.5)
+        assert "clipped_clients" not in row
+    # robust + SA + a single wave is rejected loudly, not weakened
+    with pytest.raises(ValueError, match="2 waves"):
+        train_federated_streamed(
+            model, cfg, ArrayRegistry(cx, cy, cm), tx, ty,
+            cohort_size=C, wave_size=C, num_rounds=1, seed=1,
+            mesh=client_mesh(num_devices=4),
+        )

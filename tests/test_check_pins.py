@@ -58,3 +58,50 @@ def test_guard_fires_both_directions(tmp_path):
     problems = check(pkg, doc)
     assert any("QFEDX_UNDOCUMENTED" in p for p in problems)
     assert any("QFEDX_STALE_ROW" in p for p in problems)
+
+
+# --- the fault-site taxonomy guard (r12 satellite, same family) -------------
+
+from benchmarks.check_faults import (  # noqa: E402
+    check as check_faults,
+    documented_taxonomy,
+)
+
+
+def test_fault_taxonomy_matches_source():
+    assert check_faults() == []
+
+
+def test_fault_taxonomy_covers_every_site_and_kind():
+    # The parser must see the real table; an empty parse would make the
+    # drift check vacuously pass.
+    from qfedx_tpu.utils.faults import doc_taxonomy
+
+    doc = documented_taxonomy()
+    code = doc_taxonomy()
+    assert set(doc) == set(code)
+    assert "client.byzantine" in doc
+    for kind in ("scale:k", "sign_flip", "noise", "label_flip"):
+        assert kind in doc["client.byzantine"]
+
+
+def test_fault_guard_fires_both_directions(tmp_path):
+    doc = tmp_path / "ROB.md"
+    doc.write_text(
+        "## Fault-site taxonomy\n\n"
+        "| Site | Kinds | Fires |\n|---|---|---|\n"
+        "| `client.compute` | `drop`, `nan`, `inf` | per client |\n"
+        "| `made.up_site` | `error` | never |\n"
+    )
+    problems = check_faults(doc)
+    # missing sites (byzantine, registry.fetch, ...) AND the stale row
+    assert any("client.byzantine" in p for p in problems)
+    assert any("made.up_site" in p and "stale" in p for p in problems)
+    # a row missing one KIND fires too
+    doc.write_text(
+        "## Fault-site taxonomy\n\n"
+        "| Site | Kinds |\n|---|---|\n"
+        "| `client.compute` | `drop`, `nan` |\n"
+    )
+    problems = check_faults(doc)
+    assert any("client.compute" in p and "inf" in str(p) for p in problems)

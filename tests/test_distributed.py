@@ -168,6 +168,63 @@ def test_two_process_hier_round_matches_flat_single_process(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_byzantine_defended_matches_single_process(tmp_path):
+    """r12 parity over REAL cross-process collectives: the worker pair
+    runs the 2-wave hier round with a scale:1000 attacker hosted by
+    PROCESS 1 (client 1, wave 0) and the clip_mean defense on — every
+    controller derives the same attack input from the seeded plan with
+    zero communication, the attacked upload is clipped inside the
+    cross-process program, and the defended aggregate must match the
+    single-process flat guards-on round given the same attack
+    (wave-split tolerance, tests/test_hier.py rationale)."""
+    got = _run_workers(str(tmp_path / "dist_byz_result.npz"), "byzantine")
+
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.fed.round import (
+        client_mesh,
+        make_fed_round,
+        shard_client_data,
+    )
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+    from qfedx_tpu.utils.faults import FaultPlan
+
+    num_clients, samples, n_q = 4, 8, 3
+    cfg = FedConfig(local_epochs=2, batch_size=4, learning_rate=0.1,
+                    optimizer="sgd", secure_agg=True,
+                    secure_agg_mode="ring", aggregator="clip_mean",
+                    clip_bound=0.5)
+    model = make_vqc_classifier(n_qubits=n_q, n_layers=2, num_classes=2)
+    rng = np.random.default_rng(0)
+    cx = rng.uniform(0, 1, (num_clients, samples, n_q)).astype(np.float32)
+    cy = rng.integers(0, 2, (num_clients, samples)).astype(np.int32)
+    cm = np.ones((num_clients, samples), dtype=np.float32)
+    mesh = client_mesh(num_devices=2)
+    scx, scy, scm = shard_client_data(mesh, cx, cy, jnp.asarray(cm))
+    params = model.init(jax.random.PRNGKey(0))
+    plan = FaultPlan(seed=0, rules=[{
+        "site": "client.byzantine", "kind": "scale:1000", "clients": [1],
+    }])
+    byz = plan.byzantine_attack(0, np.arange(num_clients))
+    round_fn = make_fed_round(model, cfg, mesh, num_clients=num_clients)
+    ref_params, ref_stats = round_fn(
+        params, scx, scy, scm, jax.random.PRNGKey(42), byzantine=byz
+    )
+
+    assert int(got["clipped_clients"]) == 1
+    assert int(ref_stats.clipped_clients) == 1
+    ref_leaves = jax.tree.leaves(ref_params)
+    assert len(ref_leaves) == sum(1 for k in got.files if k.startswith("leaf"))
+    for i, ref in enumerate(ref_leaves):
+        np.testing.assert_allclose(
+            got[f"leaf{i}"], np.asarray(ref), atol=2e-5, rtol=0
+        )
+    np.testing.assert_allclose(
+        got["mean_loss"], np.asarray(ref_stats.mean_loss), atol=1e-5
+    )
+    assert float(got["total_weight"]) == float(ref_stats.total_weight)
+
+
+@pytest.mark.slow
 def test_two_process_dropout_spans_process_boundary(tmp_path):
     """r11 dropout resilience over REAL cross-process collectives: the
     worker pair drops client 1 (hosted by process 1, wave 0) via a

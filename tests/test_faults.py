@@ -123,6 +123,46 @@ def fires(plan, round_idx) -> bool:
         return True
 
 
+def test_byzantine_plan_kinds_params_and_determinism():
+    """client.byzantine (r12): parameterized kinds parse, draws are
+    pure in (seed, round, ids), multipliers compose, and the attack
+    array is None exactly when every client is honest."""
+    plan = FaultPlan(seed=4, rules=[
+        {"site": "client.byzantine", "kind": "scale:100", "clients": [2]},
+        {"site": "client.byzantine", "kind": "sign_flip", "clients": [2, 5]},
+        {"site": "client.byzantine", "kind": "noise:3", "clients": [7]},
+        {"site": "client.byzantine", "kind": "label_flip", "rate": 0.25},
+    ])
+    ids = np.arange(8)
+    mult = plan.byzantine_multipliers(0, ids)
+    np.testing.assert_array_equal(
+        mult, [1, 1, -100, 1, 1, -1, 1, 1]  # scale × sign_flip compose
+    )
+    sigma = plan.byzantine_noise(0, ids)
+    assert sigma[7] == 3.0 and sigma.sum() == 3.0
+    flips = plan.label_flips(0, ids)
+    np.testing.assert_array_equal(flips, plan.label_flips(0, ids))
+    counts = plan.byzantine_counts(0, ids)
+    assert counts["scale"] == 1 and counts["sign_flip"] == 2
+    assert counts["noise"] == 1 and counts["label_flip"] == int(flips.sum())
+    atk = plan.byzantine_attack(0, ids)
+    assert atk.shape == (8, 2)
+    np.testing.assert_array_equal(atk[:, 0], mult)
+    assert FaultPlan(seed=4).byzantine_attack(0, ids) is None  # honest
+    # kind grammar is loud
+    with pytest.raises(ValueError, match="scale"):
+        FaultPlan(rules=[{"site": "client.byzantine", "kind": "scale",
+                          "clients": [1]}])
+    with pytest.raises(ValueError, match="no parameter"):
+        FaultPlan(rules=[{"site": "client.byzantine",
+                          "kind": "sign_flip:2", "clients": [1]}])
+    with pytest.raises(ValueError, match="base must be"):
+        FaultPlan(rules=[{"site": "client.byzantine", "kind": "krum",
+                          "clients": [1]}])
+    with pytest.raises(ValueError, match="exactly one"):
+        FaultPlan(rules=[{"site": "client.byzantine", "kind": "noise"}])
+
+
 def test_plan_spec_validation():
     with pytest.raises(ValueError, match="site"):
         FaultPlan(rules=[{"site": "nonsense"}])
@@ -164,6 +204,44 @@ def test_faults_pin_grammar(monkeypatch, tmp_path):
 
 
 # --- retry helper -----------------------------------------------------------
+
+
+def test_retry_jitter_is_seeded_and_decorrelates():
+    """r12 satellite: backoff jitter is a pure hash of (site, attempt)
+    — no ``random`` — so schedules reproduce exactly across reruns
+    while two SITES (concurrent uploaders/processes) land on different
+    delays instead of retrying in lockstep."""
+    from qfedx_tpu.utils.retry import jitter_factor
+
+    def schedule(site):
+        sleeps = []
+
+        def always(k):
+            raise OSError("down")
+
+        with pytest.raises(RetryExhausted):
+            retry_with_deadline(
+                always, attempts=4, base_delay_s=0.1, max_delay_s=10.0,
+                sleep=sleeps.append, jitter_site=site,
+            )
+        return sleeps
+
+    a1, a2 = schedule("ingest/0/1"), schedule("ingest/0/1")
+    b = schedule("ingest/0/2")
+    assert a1 == a2  # pure function of coordinates: reruns identical
+    assert a1 != b  # different sites de-correlate
+    for k, d in enumerate(a1):
+        base = 0.1 * 2.0 ** k
+        assert 0.5 * base <= d < base  # factor in [0.5, 1.0)
+        assert d == base * jitter_factor("ingest/0/1", k)
+    # jitter off (the default) keeps the bare exponential schedule
+    plain = []
+    with pytest.raises(RetryExhausted):
+        retry_with_deadline(
+            lambda k: (_ for _ in ()).throw(OSError("x")),
+            attempts=3, base_delay_s=0.1, sleep=plain.append,
+        )
+    assert plain == [0.1, 0.2]
 
 
 def test_retry_recovers_and_exhausts():
@@ -264,11 +342,13 @@ def test_epsilon_unchanged_by_injected_dropouts():
 
 
 def test_chaos_smoke_streamed_run(tmp_path):
-    """A streamed run under a mixed fault plan — per round: client 3
-    drops, client 5's data goes NaN, and round 1 wave 0's registry
-    fetch fails once transiently — must complete without error, keep θ
-    finite, converge on the learnable synthetic task, and report the
-    EXACT casualty counts in metrics.jsonl."""
+    """A streamed run under a mixed CRASH + BYZANTINE plan — per round:
+    client 3 drops, client 5's data goes NaN, client 6 scales its
+    upload ×1000, client 2 trains on flipped labels, and round 1 wave
+    0's registry fetch fails once transiently — must complete without
+    error under the clip_mean defense, keep θ finite, converge on the
+    learnable synthetic task, and report the EXACT casualty AND
+    byzantine counts in metrics.jsonl (r11 + r12 satellites)."""
     import jax
 
     from qfedx_tpu.data.stream import ArrayRegistry
@@ -283,12 +363,17 @@ def test_chaos_smoke_streamed_run(tmp_path):
     tx = rng.uniform(0, 1, (64, N_Q)).astype(np.float32)
     ty = (tx.mean(axis=1) > 0.5).astype(np.int32)
     model = make_vqc_classifier(n_qubits=N_Q, n_layers=2, num_classes=2)
+    # clip_bound 5.0 ≈ several honest adam-update norms: honest clients
+    # never hit it (reconciled below), the ×1000 attacker always does.
     cfg = FedConfig(local_epochs=2, batch_size=8, learning_rate=0.1,
                     optimizer="adam", secure_agg=True,
-                    secure_agg_mode="ring")
+                    secure_agg_mode="ring", aggregator="clip_mean",
+                    clip_bound=5.0)
     plan = FaultPlan(seed=0, rules=[
         {"site": "client.compute", "kind": "drop", "clients": [3]},
         {"site": "client.compute", "kind": "nan", "clients": [5]},
+        {"site": "client.byzantine", "kind": "scale:1000", "clients": [6]},
+        {"site": "client.byzantine", "kind": "label_flip", "clients": [2]},
         {"site": "registry.fetch", "rounds": [1], "waves": [0], "times": 1},
     ])
     mesh = client_mesh(num_devices=4)
@@ -304,7 +389,8 @@ def test_chaos_smoke_streamed_run(tmp_path):
     for leaf in jax.tree.leaves(res.params):
         assert np.all(np.isfinite(np.asarray(leaf)))
     assert all(np.isfinite(res.losses))
-    assert res.final_accuracy > 0.7  # converged despite 25% casualties
+    # converged despite 25% crash casualties + 25% adversaries
+    assert res.final_accuracy > 0.7
     rows = [
         json.loads(line)
         for line in (tmp_path / "metrics.jsonl").read_text().splitlines()
@@ -312,8 +398,14 @@ def test_chaos_smoke_streamed_run(tmp_path):
     assert len(rows) == num_rounds
     for r, row in enumerate(rows):
         want = plan.casualty_counts(r, np.arange(C))
+        byz = plan.byzantine_counts(r, np.arange(C))
         assert row["dropped_clients"] == want["drop"] == 1
         assert row["rejected_updates"] == want["nan"] + want["inf"] == 1
+        # EXACTLY the scale attacker hits the norm bound — honest
+        # clients (the label-flipper included) stay under it, and the
+        # quarantined NaN client never reaches the clip.
+        assert row["clipped_clients"] == byz["scale"] == 1
+        assert row["aggregator"] == "clip_mean"
         assert row["participants"] == C - 2
         assert "skipped" not in row
 

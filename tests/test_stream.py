@@ -29,6 +29,7 @@ import pytest
 from qfedx_tpu import obs
 from qfedx_tpu.data.stream import (
     ArrayRegistry,
+    DroppedWave,
     StreamError,
     SyntheticRegistry,
     WaveStream,
@@ -246,6 +247,153 @@ def test_wave_stream_retries_transient_faults_in_place():
         next(stream2)
     assert ei.value.wave == 1
     stream2.close()
+
+
+def test_retry_exhaustion_converts_wave_to_dropped_marker():
+    """r12 satellite, failure shape 1 (fails fast, persistently): with
+    ``on_wave_error="drop"`` a wave whose fetch exhausts the retry
+    arrives as a DroppedWave marker IN its cohort slot — the other
+    waves' bytes are untouched and the stream neither stalls nor dies."""
+    from qfedx_tpu.utils.faults import FaultPlan
+
+    cx, cy, cm = _data(C=16)
+    reg = ArrayRegistry(cx, cy, cm)
+    mesh = client_mesh(num_devices=4)
+    plan = FaultPlan(seed=0, rules=[
+        {"site": "registry.fetch", "waves": [1]},  # no times = persistent
+    ])
+    for depth in (0, 1):
+        stream = WaveStream(reg, mesh, np.arange(16), wave_size=4,
+                            depth=depth, fault_plan=plan, round_idx=0,
+                            on_wave_error="drop")
+        got = list(stream)
+        stream.close()
+        assert len(got) == 4
+        assert isinstance(got[1], DroppedWave)
+        assert got[1].wave == 1 and got[1].wave_base == 4
+        assert isinstance(got[1].error, StreamError)
+        for item in (got[0], got[2], got[3]):
+            wave_base, (wx, _wy, _wm) = item
+            np.testing.assert_array_equal(
+                np.asarray(wx), cx[wave_base:wave_base + 4]
+            )
+    with pytest.raises(ValueError, match="on_wave_error"):
+        WaveStream(reg, mesh, np.arange(16), wave_size=4,
+                   on_wave_error="retry")
+
+
+def test_wave_deadline_converts_hung_fetch_no_hang():
+    """r12 satellite, failure shape 2 (hangs, never fails): a wave
+    whose fetch SLEEPS past ``wave_deadline_s`` converts into a
+    DroppedWave promptly; when the uploader later unsticks and delivers
+    the stale wave it is DISCARDED (never both dropped and computed)
+    and the remaining waves flow normally. In "raise" mode the deadline
+    is a prompt typed error instead of a silent stall."""
+    import time
+
+    cx, cy, cm = _data(C=16)
+
+    class Hanging:
+        num_clients = 16
+
+        def batch(self, ids):
+            if ids[0] == 4:  # wave 1 hangs well past the deadline
+                time.sleep(2.0)
+            return cx[ids], cy[ids], cm[ids]
+
+    mesh = client_mesh(num_devices=4)
+    # deadline 1.2 < the 2.0 s hang (wave 1 converts) but the uploader
+    # unsticks INSIDE wave 2's window, so the stale wave-1 delivery is
+    # discarded and waves 2/3 still flow.
+    stream = WaveStream(Hanging(), mesh, np.arange(16), wave_size=4,
+                        depth=1, on_wave_error="drop",
+                        wave_deadline_s=1.2)
+    t0 = time.perf_counter()
+    got = list(stream)
+    stream.close()
+    assert time.perf_counter() - t0 < 6.0  # no-hang, bounded by sleeps
+    dropped = [g for g in got if isinstance(g, DroppedWave)]
+    served = [g for g in got if not isinstance(g, DroppedWave)]
+    assert [d.wave for d in dropped] == [1]
+    assert "deadline" in str(dropped[0].error)
+    # every OTHER wave arrived exactly once with the right bytes
+    assert sorted(g[0] for g in served) == [0, 8, 12]
+    for wave_base, (wx, _wy, _wm) in served:
+        np.testing.assert_array_equal(
+            np.asarray(wx), cx[wave_base:wave_base + 4]
+        )
+    # raise mode: the deadline surfaces as a prompt typed error
+    stream2 = WaveStream(Hanging(), mesh, np.arange(16), wave_size=4,
+                         depth=1, wave_deadline_s=0.4)
+    assert next(stream2)[0] == 0
+    t0 = time.perf_counter()
+    with pytest.raises(StreamError, match="deadline"):
+        next(stream2)
+    assert time.perf_counter() - t0 < 1.5
+    stream2.close()
+
+
+def test_trainer_converts_dead_wave_to_dropouts_with_mask_recovery():
+    """The trainer-level pin (r12 satellite): a persistently failing
+    wave becomes survivor-mask dropouts — the round COMPLETES, the
+    casualties are accounted exactly, and under ring secure-agg the
+    regenerated-mask correction holds: at lr=0 θ matches the fault-free
+    run to float dust even though a whole wave's pair partners died."""
+    from qfedx_tpu.utils.faults import FaultPlan
+
+    cx, cy, cm = _data(seed=9)
+    tx, ty = _test_set()
+    model = _model()
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.0, momentum=0.0,
+        secure_agg=True, secure_agg_mode="ring",
+    )
+    mesh = client_mesh(num_devices=4)
+    reg = ArrayRegistry(cx, cy, cm)
+    kw = dict(cohort_size=16, wave_size=4, num_rounds=1, seed=3,
+              eval_every=3, mesh=mesh)
+    clean = train_federated_streamed(model, cfg, reg, tx, ty, **kw)
+    plan = FaultPlan(seed=0, rules=[{"site": "registry.fetch", "waves": [1]}])
+    rows = []
+    dead = train_federated_streamed(
+        model, cfg, reg, tx, ty, fault_plan=plan,
+        on_round_end=lambda r, m: rows.append(m), **kw,
+    )
+    assert rows[0]["dropped_clients"] == 4  # the whole wave, exactly
+    assert rows[0]["dropped_waves"] == 1
+    assert rows[0]["participants"] == 12
+    for a, b in zip(
+        jax.tree.leaves(clean.params), jax.tree.leaves(dead.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=0
+        )
+    # A plan-dropped client INSIDE the dead wave is still one casualty,
+    # counted once: the wave's SAMPLED clients all drop (its wave never
+    # dispatched, so the in-program counter cannot see any of them).
+    plan_both = FaultPlan(seed=0, rules=[
+        {"site": "registry.fetch", "waves": [1]},
+        {"site": "client.compute", "kind": "drop", "clients": [5]},  # wave 1
+    ])
+    rows_b = []
+    train_federated_streamed(
+        model, cfg, reg, tx, ty, fault_plan=plan_both,
+        on_round_end=lambda r, m: rows_b.append(m), **kw,
+    )
+    assert rows_b[0]["dropped_clients"] == 4
+    assert rows_b[0]["participants"] == 12
+    # EVERY wave dead ⇒ the round degrades to a logged skip, θ intact
+    plan_all = FaultPlan(seed=0, rules=[{"site": "registry.fetch"}])
+    rows_all = []
+    res_all = train_federated_streamed(
+        model, cfg, reg, tx, ty, fault_plan=plan_all,
+        on_round_end=lambda r, m: rows_all.append(m), **kw,
+    )
+    assert rows_all[0].get("skipped") is True
+    assert rows_all[0]["dropped_clients"] == 16
+    assert rows_all[0]["participants"] == 0
+    assert all(np.isfinite(np.ravel(np.asarray(l)))
+               .all() for l in jax.tree.leaves(res_all.params))
 
 
 def test_uploader_death_without_sentinel_raises_promptly():
