@@ -78,6 +78,24 @@ class DroppedWave:
         return f"DroppedWave(wave={self.wave}, base={self.wave_base})"
 
 
+class LateWave:
+    """A STRAGGLER marker (r13): the wave missed ``wave_deadline_s``
+    but — unlike a ``DroppedWave`` — its upload keeps running in the
+    background. With ``on_wave_error="buffer"`` the stream yields this
+    marker in the wave's cohort slot and the finished upload is
+    delivered later through ``poll_late`` instead of being discarded;
+    the streamed trainer computes the wave's ``RoundPartial`` against
+    its ORIGIN round's θ/keys and parks it in the staleness buffer
+    (docs/ROBUSTNESS.md staleness section)."""
+
+    def __init__(self, wave: int, wave_base: int):
+        self.wave = wave
+        self.wave_base = wave_base
+
+    def __repr__(self):
+        return f"LateWave(wave={self.wave}, base={self.wave_base})"
+
+
 def resolve_stream_depth(depth: int | None = None) -> int:
     """Prefetch depth of the wave uploader: how many uploaded-but-unread
     waves may be staged ahead of compute. An explicit ``depth`` wins;
@@ -200,6 +218,13 @@ class WaveStream:
     consumer-side wave deadline — is yielded as a ``DroppedWave``
     marker in its cohort slot instead of killing the stream; the
     trainer converts it into survivor-mask dropouts.
+    ``on_wave_error="buffer"`` (r13): same, except a deadline-missed
+    wave yields a ``LateWave`` marker and its upload FINISHES in the
+    background — ``poll_late`` hands the completed wave over later so
+    the trainer can fold it into a subsequent round with a staleness
+    discount instead of discarding the work; the plan's ``client.slow``
+    / ``wave.delay`` rules inject deterministic stragglers as real
+    uploader sleeps.
     """
 
     _DONE = object()
@@ -253,11 +278,17 @@ class WaveStream:
         # against a fetch that hangs rather than fails (a stuck uploader
         # thread can serve no later wave either, so under "drop" every
         # remaining wave converts; under "raise" it is a prompt typed
-        # error instead of a silent stall).
-        if on_wave_error not in ("raise", "drop"):
+        # error instead of a silent stall). "buffer" (r13) extends
+        # "drop": a retry-EXHAUSTED wave is still a DroppedWave (its
+        # data will never exist), but a deadline-missed wave becomes a
+        # LateWave — the uploader finishes it in the background and
+        # ``poll_late`` hands the completed upload to the trainer later
+        # (the straggler-salvage path; needs depth ≥ 1, since the
+        # synchronous path has no background to finish in).
+        if on_wave_error not in ("raise", "drop", "buffer"):
             raise ValueError(
-                f"on_wave_error={on_wave_error!r}: expected 'raise' or "
-                "'drop'"
+                f"on_wave_error={on_wave_error!r}: expected 'raise', "
+                "'drop' or 'buffer'"
             )
         self._on_wave_error = on_wave_error
         self._wave_deadline_s = (
@@ -266,13 +297,37 @@ class WaveStream:
         if self._wave_deadline_s is not None and self._wave_deadline_s <= 0:
             raise ValueError("wave_deadline_s must be > 0 (None disables)")
         self._abandoned: set[int] = set()
+        # Buffer-mode late-wave ledger: completed uploads of abandoned
+        # waves park in _late_items until poll_late collects them;
+        # waves that will never complete (retry exhausted after the
+        # deadline, uploader death) land in _late_failed; _late_done
+        # records waves already handed to the trainer so outstanding
+        # accounting stays exact.
+        self._late_items: dict[int, tuple] = {}
+        self._late_failed: set[int] = set()
+        self._late_done: set[int] = set()
+        # Injected straggle (r13, client.slow / wave.delay fault sites):
+        # seconds the uploader sleeps before fetching each wave.
+        self._delays = None
+        if fault_plan is not None:
+            d = fault_plan.wave_delays(
+                int(round_idx), cohort_ids, int(wave_size)
+            )
+            if np.any(d > 0):
+                self._delays = d
         self.depth = resolve_stream_depth(depth)
         self._next_wave = 0
         self._closed = False
         self._queue: queue.Queue | None = None
         self._thread: threading.Thread | None = None
-        if self.depth > 0 and self.num_waves > 1:
-            self._queue = queue.Queue(maxsize=self.depth)
+        # Buffer mode ALWAYS runs the uploader thread: "the straggler
+        # finishes in the background" needs a background — the
+        # synchronous path could neither abandon a slow fetch nor
+        # complete it after the round moved on.
+        if (self.depth > 0 and self.num_waves > 1) or (
+            self._on_wave_error == "buffer"
+        ):
+            self._queue = queue.Queue(maxsize=max(self.depth, 1))
             self._thread = threading.Thread(
                 target=self._uploader, name="qfedx-ingest", daemon=True
             )
@@ -287,6 +342,15 @@ class WaveStream:
         on in-flight waves and H2D genuinely overlap."""
         lo = wave * self._wave_size
         ids = self._ids[lo:lo + self._wave_size]
+        # Injected straggle (client.slow / wave.delay): sleep ONCE per
+        # wave, before the retry loop — a straggler is slow, not flaky,
+        # so retries must not compound the delay.
+        if self._delays is not None and float(self._delays[wave]) > 0:
+            with obs.span(
+                "ingest.straggle", wave=wave,
+                seconds=float(self._delays[wave]),
+            ):
+                time.sleep(float(self._delays[wave]))
 
         def attempt(k: int):
             if self._plan is not None:
@@ -358,13 +422,37 @@ class WaveStream:
     def _uploader(self) -> None:
         wave = 0
         try:
+            deferred: list[int] = []
             for wave in range(self.num_waves):
                 if self._closed:
                     break
+                if (
+                    self._on_wave_error == "buffer"
+                    and self._wave_deadline_s is not None
+                    and self._delays is not None
+                    and float(self._delays[wave]) > self._wave_deadline_s
+                ):
+                    # Deterministic straggler injection (r13): a wave
+                    # whose PLANNED delay already exceeds the consumer
+                    # deadline is declared late up front — a LateWave
+                    # marker lands in its cohort slot immediately and
+                    # the actual (slow) upload is deferred behind every
+                    # prompt wave, so one injected straggler never
+                    # head-of-line-blocks the in-order uploader into
+                    # making the rest of the round late too. (Genuine,
+                    # unplanned slowness still goes through the
+                    # consumer-deadline path below, where blocking the
+                    # line IS the observed behavior.)
+                    if not self._put(
+                        LateWave(wave, wave * self._wave_size)
+                    ):
+                        return
+                    deferred.append(wave)
+                    continue
                 try:
                     item = self._upload(wave)
                 except StreamError as exc:
-                    if self._on_wave_error != "drop":
+                    if self._on_wave_error not in ("drop", "buffer"):
                         raise
                     # r12: this wave is past the retry deadline — it
                     # becomes a casualty marker in its cohort slot and
@@ -379,6 +467,21 @@ class WaveStream:
                 if not self._put(item):
                     return
                 obs.gauge("ingest.queue_depth", self._queue.qsize())
+            for wave in deferred:
+                if self._closed:
+                    break
+                # Background completion of declared stragglers: the
+                # injected sleep (and the real fetch + H2D) runs HERE,
+                # after every prompt wave shipped; the result lands in
+                # the consumer's late storage via poll_late.
+                try:
+                    item = self._upload(wave)
+                except StreamError as exc:
+                    item = DroppedWave(
+                        wave, wave * self._wave_size, error=exc
+                    )
+                if not self._put(item):
+                    return
         except BaseException as exc:  # noqa: BLE001 — re-raised by consumer
             # ALWAYS a typed StreamError on the queue (r11 satellite):
             # the consumer learns which wave died and why, promptly,
@@ -441,7 +544,14 @@ class WaveStream:
                         and time.monotonic() - t0 > self._wave_deadline_s
                     ):
                         wave = self._next_wave
-                        if self._on_wave_error == "drop":
+                        if self._on_wave_error == "buffer":
+                            # Straggler salvage (r13): abandon WAITING,
+                            # not the wave — the uploader keeps working
+                            # and the finished upload is collected via
+                            # poll_late instead of discarded.
+                            self._abandoned.add(wave)
+                            item = LateWave(wave, wave * self._wave_size)
+                        elif self._on_wave_error == "drop":
                             # The uploader may deliver this wave later —
                             # remember to discard that stale item so the
                             # wave is never BOTH dropped and computed.
@@ -463,15 +573,40 @@ class WaveStream:
                             ) from None
                     else:
                         continue
-                # Discard stale deliveries of waves the deadline already
-                # declared dead (the uploader unstuck after the fact).
-                if isinstance(item, DroppedWave):
+                # Stale deliveries of waves the deadline already declared
+                # late/dead (the uploader unstuck after the fact):
+                # "buffer" banks them for poll_late; "drop" discards —
+                # either way the wave is never BOTH handled and computed
+                # fresh.
+                if isinstance(item, LateWave):
+                    if item.wave < self._next_wave:
+                        # Stale marker: the consumer's own deadline
+                        # already declared this wave late (the uploader
+                        # was stuck behind an earlier slow wave when it
+                        # queued its declaration) — re-yielding it
+                        # would shift every later wave's cohort slot.
+                        continue
+                    # Uploader-declared straggler (planned delay >
+                    # deadline): register it so the deferred background
+                    # delivery routes to late storage, then yield the
+                    # marker in its cohort slot.
+                    self._abandoned.add(item.wave)
+                elif isinstance(item, DroppedWave):
                     if item.wave in self._abandoned and (
                         item.wave < self._next_wave
                     ):
+                        if self._on_wave_error == "buffer":
+                            # Late AND failed for good: the straggler's
+                            # retry exhausted after the deadline — it
+                            # will never complete.
+                            self._late_failed.add(item.wave)
                         continue
                 elif isinstance(item, tuple):
                     if item[0] // self._wave_size in self._abandoned:
+                        if self._on_wave_error == "buffer":
+                            self._late_items[
+                                item[0] // self._wave_size
+                            ] = item
                         continue
                 break
             obs.gauge("ingest.queue_depth", self._queue.qsize())
@@ -488,7 +623,114 @@ class WaveStream:
             # both misses the deadline and later exhausts its retry
             # yields one discarded stale marker, not a double count.
             obs.counter("ingest.waves_dropped")
+        elif isinstance(item, LateWave):
+            obs.counter("ingest.waves_late")
         return item
+
+    # -- straggler salvage (buffer mode, r13) --------------------------------
+
+    def _late_outstanding_set(self) -> set[int]:
+        """Abandoned waves whose fate is still unknown: not yet
+        delivered, not yet declared failed, not yet handed over."""
+        return (
+            self._abandoned
+            - set(self._late_items)
+            - self._late_failed
+            - self._late_done
+        )
+
+    def late_pending(self) -> bool:
+        """Anything for ``poll_late`` to return — now or eventually?
+        False means the stream is fully resolved and safe to close."""
+        return bool(
+            self._late_items
+            or self._late_failed
+            or self._late_outstanding_set()
+        )
+
+    def poll_late(self, timeout_s: float = 0.0):
+        """Collect straggler waves the deadline abandoned (buffer mode).
+
+        Returns ``(items, failed)``: ``items`` — the completed uploads,
+        as the same ``(wave_base, (cx, cy, cmask))`` tuples ``__next__``
+        yields, in cohort order; ``failed`` — wave indices that will
+        NEVER complete (retry exhausted after the deadline, or the
+        uploader died). Waits up to ``timeout_s`` for still-outstanding
+        late waves — the trainer passes a real bound here so a
+        one-round-late straggler folds into the very next round
+        deterministically — then returns whatever has resolved; call
+        again later for the rest (``late_pending`` says whether any
+        remain). Each wave is returned exactly once."""
+        if self._on_wave_error != "buffer":
+            raise RuntimeError(
+                "poll_late requires on_wave_error='buffer'"
+            )
+        deadline = time.monotonic() + float(timeout_s)
+        while self._queue is not None and self._late_outstanding_set():
+            try:
+                item = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                if (
+                    self._thread is not None
+                    and not self._thread.is_alive()
+                ):
+                    try:  # a final racing put may have landed
+                        item = self._queue.get_nowait()
+                    except queue.Empty:
+                        # Nothing else is coming: the rest are dead.
+                        self._late_failed.update(
+                            self._late_outstanding_set()
+                        )
+                        break
+                elif time.monotonic() >= deadline:
+                    break
+                else:
+                    continue
+            if item is self._DONE:
+                continue
+            if isinstance(item, BaseException):
+                # Uploader died for good mid-salvage: every still-
+                # outstanding straggler is lost with it.
+                self._late_failed.update(self._late_outstanding_set())
+                continue
+            if isinstance(item, LateWave):
+                # A declaration the consumer never got to (its own
+                # deadline already covered the wave): the data is still
+                # coming on the deferred pass — just register it.
+                self._abandoned.add(item.wave)
+                continue
+            if isinstance(item, DroppedWave):
+                if item.wave in self._abandoned:
+                    self._late_failed.add(item.wave)
+                continue
+            wave = item[0] // self._wave_size
+            if wave in self._abandoned and wave not in self._late_done:
+                self._late_items[wave] = item
+        items = [
+            self._late_items.pop(w) for w in sorted(self._late_items)
+        ]
+        failed = sorted(self._late_failed)
+        self._late_done.update(w[0] // self._wave_size for w in items)
+        self._late_done.update(failed)
+        self._late_failed.clear()
+        if items:
+            obs.counter("ingest.waves_salvaged", len(items))
+        return items, failed
+
+    def abandon_late(self) -> list[int]:
+        """Give up on every still-unresolved straggler (over-age, or
+        shutdown): returns their wave indices — the trainer counts the
+        clients as casualties — and marks them done so ``late_pending``
+        goes False and the stream can close."""
+        waves = sorted(
+            self._late_outstanding_set()
+            | set(self._late_items)
+            | self._late_failed
+        )
+        self._late_done.update(waves)
+        self._late_items.clear()
+        self._late_failed.clear()
+        return waves
 
     def close(self) -> None:
         """Stop the uploader and release staged waves (safe to call on a

@@ -81,6 +81,16 @@ class RDPAccountant:
     a skipped round (min_participation) is still charged — the noise
     draw existed even if θ ignored it. Pinned dropout-invariant in
     tests/test_faults.py.
+
+    Staleness invariance (r13): the same principle covers STRAGGLERS —
+    a buffered wave's DP noise was drawn (and its ε charged) at the
+    ORIGIN round's sampling step; folding the already-privatized
+    partial into a later round at a staleness discount is
+    post-processing, which costs nothing. The accountant therefore
+    never sees lateness: callers charge one step per round at the
+    sampled cohort's q, whenever that round's uploads actually land —
+    ε is pinned invariant under injected delays in
+    tests/test_staleness.py.
     """
 
     orders: np.ndarray = field(default_factory=lambda: DEFAULT_ORDERS.copy())

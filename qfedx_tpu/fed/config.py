@@ -103,6 +103,25 @@ class FedConfig:
     aggregator: str = "mean"
     clip_bound: float = float("inf")  # L2 bound for clip_mean (∞ = elided)
     trim_fraction: float = 0.1  # per-END trim for trimmed_mean (< 0.5)
+    # Staleness-aware buffered aggregation (r13, docs/ROBUSTNESS.md):
+    # activation is the QFEDX_STALE BUILD-time pin (default off — the
+    # r12 program bit-for-bit); these fields shape the discount s(τ)
+    # applied when a straggler wave's RoundPartial, parked τ rounds in
+    # the staleness buffer, folds into a later round's apply
+    # (fed/robust.staleness_discount):
+    #
+    # - "constant" — s(τ) = staleness_alpha for every τ ≥ 1 (fresh waves
+    #   always weigh 1.0); the FedAsync constant-discount rule.
+    # - "poly"     — s(τ) = (1 + τ)^(−staleness_alpha); the FedBuff-style
+    #   polynomial decay (τ = 0 ⇒ exactly 1.0 by construction).
+    #
+    # staleness_max_age bounds the buffer: a parked partial older than
+    # this many rounds is discarded (its clients become casualties) —
+    # an unboundedly slow straggler cannot pin host memory or steer θ
+    # with arbitrarily ancient gradients.
+    staleness_mode: str = "constant"  # "constant" | "poly"
+    staleness_alpha: float = 0.5
+    staleness_max_age: int = 2
 
     def __post_init__(self):
         if self.algorithm not in ("fedavg", "fedprox"):
@@ -131,6 +150,28 @@ class FedConfig:
             raise ValueError(
                 f"trim_fraction={self.trim_fraction} must be in [0, 0.5) — "
                 "trimming half or more from each end leaves nothing"
+            )
+        if self.staleness_mode not in ("constant", "poly"):
+            raise ValueError(
+                f"unknown staleness_mode {self.staleness_mode!r} "
+                "(expected 'constant' or 'poly')"
+            )
+        if self.staleness_mode == "constant" and not (
+            0.0 < self.staleness_alpha <= 1.0
+        ):
+            raise ValueError(
+                f"constant staleness_alpha={self.staleness_alpha} must be "
+                "in (0, 1] — 0 discards every stale wave (use 'drop'), "
+                "> 1 would amplify stale gradients"
+            )
+        if self.staleness_mode == "poly" and not self.staleness_alpha >= 0.0:
+            raise ValueError(
+                f"poly staleness_alpha={self.staleness_alpha} must be >= 0"
+            )
+        if self.staleness_max_age < 1:
+            raise ValueError(
+                f"staleness_max_age={self.staleness_max_age} must be >= 1 "
+                "— a buffered wave needs at least one later round to land"
             )
         if (
             self.dp is not None

@@ -27,6 +27,14 @@ share: contributors are a leading axis, absentees are pushed out of the
 order with NaN (``jnp.sort`` orders NaN last), and the kept range is a
 traced function of the live count so client sampling, dropouts and
 quarantines never change the compiled program.
+
+r13 adds the STALENESS axis on the same cross-wave seam:
+``staleness_discount`` computes s(τ) per stacked wave (constant /
+polynomial, ``FedConfig.staleness_*``) and ``make_apply_partials``
+scales each wave's contribution by it — under the robust rules the
+combine runs over MIXED-AGE wave means (a stale wave is one more
+contributor, shrunk toward 0 by its discount before the sort), so a
+straggler can neither dominate a later round nor evade the trim.
 """
 
 from __future__ import annotations
@@ -56,6 +64,24 @@ def resolve_aggregator(cfg) -> str:
             f"QFEDX_AGG={env!r}: expected one of {AGGREGATORS}"
         )
     return low
+
+
+def staleness_discount(mode: str, alpha: float, ages):
+    """s(τ) per contributor: the staleness discount (r13) applied when a
+    straggler wave's ``RoundPartial`` folds into a later round's apply.
+
+    ``ages``: [W] float — rounds of lateness per stacked wave (0 =
+    fresh). ``"constant"`` is the FedAsync rule (s = α for any τ ≥ 1);
+    ``"poly"`` is the FedBuff-style decay s = (1 + τ)^−α. Both are
+    EXACTLY 1.0 at τ = 0, so an all-fresh round's discounted apply
+    computes the same weighted mean as the undiscounted one — the
+    staleness axis costs nothing until a wave is actually late."""
+    ages = jnp.asarray(ages, jnp.float32)
+    if mode == "constant":
+        return jnp.where(ages > 0, jnp.float32(alpha), jnp.float32(1.0))
+    if mode == "poly":
+        return (1.0 + ages) ** jnp.float32(-alpha)
+    raise ValueError(f"unknown staleness mode {mode!r}")
 
 
 def clip_update(delta, bound: float):

@@ -55,6 +55,7 @@ from qfedx_tpu.fed.robust import (
     clip_update,
     resolve_aggregator,
     robust_combine,
+    staleness_discount,
     trimmed_fraction_stat,
 )
 from qfedx_tpu.fed.sampling import participation_mask
@@ -147,6 +148,29 @@ def hier_enabled() -> bool:
     return pins.bool_pin("QFEDX_HIER", True)
 
 
+def stale_enabled() -> bool:
+    """Build the staleness-aware round programs (r13)?
+
+    ``QFEDX_STALE`` (``0``/``off``/``1``/``on``, default OFF) pins at
+    BUILD time whether the hierarchical round carries the staleness
+    axis: ``make_fed_round_partial`` restricts secure-agg pair graphs
+    to each WAVE (so a straggler wave's partial is a self-contained,
+    self-cancelling unit that can land in a LATER round without mask
+    corruption — the same per-wave-graph construction the robust rules
+    use), ``make_apply_partials`` accepts per-wave ages and applies the
+    staleness discount s(τ) (``FedConfig.staleness_*``,
+    ``fed/robust.staleness_discount``), and the streamed trainer runs
+    its WaveStreams in ``on_wave_error="buffer"`` mode — a
+    deadline-expired wave finishes uploading in the background and its
+    completed ``RoundPartial`` (computed against the ORIGIN round's θ,
+    keys and survivor set) parks in a bounded staleness buffer instead
+    of becoming casualties. Off (the default) builds the exact r12
+    program — the bit-parity lever, pinned across the SA × DP × waves
+    matrix in tests/test_staleness.py.
+    """
+    return pins.bool_pin("QFEDX_STALE", False)
+
+
 def fold_clients_enabled(model: Model, cfg: FedConfig) -> bool:
     """Fold the client axis into the engine batch instead of vmapping the
     local update over C clients?
@@ -209,6 +233,7 @@ def _make_per_device_partial(
     guards: bool = False,
     with_survivors: bool = False,
     with_attack: bool = False,
+    wave_graph: bool = False,
 ):
     """Shared per-device body of the flat AND hierarchical round programs.
 
@@ -261,11 +286,21 @@ def _make_per_device_partial(
     partials stay individually meaningful for the cross-wave robust
     combine in ``make_apply_partials``) — the per-wave-aggregate
     visibility trade docs/ROBUSTNESS.md spells out.
+
+    ``wave_graph=True`` (r13) applies the SAME per-wave pair-graph
+    restriction under ANY aggregator: staleness-aware buffering needs
+    every wave's partial to be a self-cancelling unit (its ring masks
+    pair only within the wave), because a straggler wave's partial may
+    fold into a LATER round whose other waves drew different graphs —
+    a cohort-wide graph would leave its cross-wave mask edges
+    permanently unmatched. The construction is identical to the robust
+    rules'; only the reason differs.
     """
     agg = resolve_aggregator(cfg)
     do_clip = agg == "clip_mean" and math.isfinite(cfg.clip_bound)
     robust = agg in ROBUST_AGGREGATORS
     robust_per_client = robust and not cfg.secure_agg
+    per_wave_graph = robust or wave_graph
     local_update = make_local_update(model, cfg)
     folded = fold_clients_enabled(model, cfg)
     local_update_c = (
@@ -304,12 +339,13 @@ def _make_per_device_partial(
             # compile it separately so a fault-free run never carries
             # the survivor input or its multiplies).
             eff = part * survivors if survivors is not None else part
-            if cfg.secure_agg and robust:
-                # Robust hierarchy under masking (r12): the pair graph
-                # is restricted to THIS wave's effective participants,
-                # so ring masks cancel inside the wave's own partial —
-                # the cross-wave robust combine then operates on clean
-                # per-wave aggregates instead of mask-corrupted ones.
+            if cfg.secure_agg and per_wave_graph:
+                # Per-wave pair graphs (r12 robust rules, r13 staleness):
+                # the graph is restricted to THIS wave's effective
+                # participants, so ring masks cancel inside the wave's
+                # own partial — the cross-wave robust combine operates
+                # on clean per-wave aggregates, and a straggler wave's
+                # partial stays self-cancelling wherever it lands.
                 ids_all = jnp.arange(num_clients)
                 in_wave = (
                     (ids_all >= wave_base)
@@ -834,6 +870,11 @@ def make_fed_round_partial(
     """
     cohort = wave_clients if cohort_clients is None else cohort_clients
     guards = guards_enabled()
+    # r13: with staleness buffering pinned on, EVERY wave draws a
+    # wave-restricted secure-agg pair graph (self-cancelling partials —
+    # see _make_per_device_partial's wave_graph note); off keeps the
+    # cohort-wide graph and the exact r12 program.
+    stale = stale_enabled()
     if (
         resolve_aggregator(cfg) in ROBUST_AGGREGATORS
         and cfg.secure_agg
@@ -854,7 +895,7 @@ def make_fed_round_partial(
         per_partial = _make_per_device_partial(
             model, cfg, wave_clients, cohort, axis, mesh.shape[axis],
             guards=guards, with_survivors=with_survivors,
-            with_attack=with_attack,
+            with_attack=with_attack, wave_graph=stale,
         )
         specs = (P(), P(axis), P(axis), P(axis), P(), P())
         if with_survivors:
@@ -969,6 +1010,23 @@ def make_apply_partials(
     waves; ``min_participation`` applies at the cohort root;
     ``stats.trimmed_fraction`` reports the cross-wave combine's
     exclusion rate.
+
+    ``ages`` (r13, staleness-aware buffering): an optional [W] float32
+    of per-wave lateness — 0 for this round's fresh waves, τ ≥ 1 for a
+    buffered straggler partial from τ rounds ago. The staleness
+    discount s(τ) (``fed/robust.staleness_discount``,
+    ``cfg.staleness_mode``/``staleness_alpha``) scales each wave's
+    contribution: under ``mean``/``clip_mean`` both the weighted delta
+    sum AND the weight are scaled (θ ← θ + Σ s·wΔ / Σ s·w — the
+    FedBuff-shaped discounted mean), so a stale wave moves θ but never
+    more than its discount allows; under the robust rules each wave's
+    MEAN is scaled before the coordinate-wise combine (a stale
+    contribution shrinks toward 0 — mixed-age partials share one sorted
+    order, so a straggler cannot evade the trim). Ledger counts
+    (participants, casualties, clips) stay UNdiscounted — stale clients
+    genuinely participated. ``ages=None`` (the only spelling the
+    QFEDX_STALE=off trainer uses) selects a separately-compiled program
+    with no discount ops at all — the r12 apply exactly.
     """
     agg = resolve_aggregator(cfg) if cfg is not None else "mean"
     min_count = (
@@ -976,14 +1034,38 @@ def make_apply_partials(
     )
     robust = agg in ROBUST_AGGREGATORS
 
-    def apply_fn(params, stacked: RoundPartial):
+    def _body(params, stacked: RoundPartial, ages):
         with jax.named_scope("aggregate"):
-            if not robust:
-                partial = jax.tree.map(
-                    lambda t: jnp.sum(t, axis=0), stacked
-                )
-                return _finalize_partial(params, partial, min_count)
             w = stacked.weight_sum  # [W]
+            s = (
+                None
+                if ages is None
+                else staleness_discount(
+                    cfg.staleness_mode, cfg.staleness_alpha, ages
+                )
+            )
+            if not robust:
+                if s is None:
+                    partial = jax.tree.map(
+                        lambda t: jnp.sum(t, axis=0), stacked
+                    )
+                    return _finalize_partial(params, partial, min_count)
+
+                def dsum(t):
+                    sr = s.reshape((-1,) + (1,) * (t.ndim - 1))
+                    return jnp.sum(t * sr.astype(t.dtype), axis=0)
+
+                with jax.named_scope("staleness_discount"):
+                    partial = RoundPartial(
+                        update_sum=jax.tree.map(dsum, stacked.update_sum),
+                        weight_sum=jnp.sum(w * s),
+                        loss_sum=jnp.sum(stacked.loss_sum * s),
+                        num_participants=jnp.sum(stacked.num_participants),
+                        rejected_updates=jnp.sum(stacked.rejected_updates),
+                        dropped_clients=jnp.sum(stacked.dropped_clients),
+                        clipped_clients=jnp.sum(stacked.clipped_clients),
+                    )
+                return _finalize_partial(params, partial, min_count)
             present = (w > 0).astype(jnp.float32)
             wave_means = jax.tree.map(
                 lambda u: u
@@ -992,6 +1074,18 @@ def make_apply_partials(
                 ).astype(u.dtype),
                 stacked.update_sum,
             )
+            if s is not None:
+                # Mixed-age robust combine: a stale wave's mean shrinks
+                # by its discount BEFORE the coordinate-wise sort — one
+                # order over fresh and stale contributors alike.
+                with jax.named_scope("staleness_discount"):
+                    wave_means = jax.tree.map(
+                        lambda u: u
+                        * s.reshape((-1,) + (1,) * (u.ndim - 1)).astype(
+                            u.dtype
+                        ),
+                        wave_means,
+                    )
             combined, _m_w, tf = robust_combine(
                 wave_means, present, agg, cfg.trim_fraction
             )
@@ -1011,7 +1105,29 @@ def make_apply_partials(
                 params, partial, min_count, trimmed_fraction=tf
             )
 
-    return jax.jit(apply_fn)
+    # Two lazily-shared programs, the r11 variant-seam idiom: the
+    # no-ages apply is the r12 program exactly (no discount ops); the
+    # aged variant traces on the first call that actually carries a
+    # stale wave (or a fresh stack under QFEDX_STALE, where ages = 0
+    # and s ≡ 1).
+    plain = jax.jit(lambda params, stacked: _body(params, stacked, None))
+    variants: dict = {}
+
+    def apply_fn(params, stacked: RoundPartial, ages=None):
+        if ages is None:
+            return plain(params, stacked)
+        if cfg is None:
+            raise ValueError(
+                "ages requires a FedConfig (staleness_mode/"
+                "staleness_alpha shape the discount)"
+            )
+        if "aged" not in variants:
+            variants["aged"] = jax.jit(_body)
+        return variants["aged"](
+            params, stacked, jnp.asarray(ages, jnp.float32)
+        )
+
+    return apply_fn
 
 
 def stack_partials(parts):

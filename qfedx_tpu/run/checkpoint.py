@@ -30,10 +30,18 @@ exponential-backoff policy (``utils/retry``) before surfacing a typed
 fails the write outright — and consults the fault harness's
 ``checkpoint.write`` site (``utils/faults``, QFEDX_FAULTS) so that
 recovery path is deterministically testable.
+
+r13: every checkpoint carries a sha256 sidecar (``ckpt_NNNNNN.sha256``,
+same tmp+rename durability) verified on resume; ``restore_latest``
+falls back to the previous LAST-GOOD checkpoint with a logged warning
+instead of crashing on a torn/corrupt file (``keep`` ≥ 2 retains the
+fallback target), while an explicit ``restore(round)`` raises the
+typed ``CheckpointIntegrityError`` loudly.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import queue as queue_mod
@@ -48,6 +56,16 @@ import numpy as np
 from qfedx_tpu.utils import faults
 from qfedx_tpu.utils.host import is_primary
 from qfedx_tpu.utils.retry import RetryExhausted, retry_with_deadline
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint on disk does not match its sha256 sidecar (or cannot
+    be parsed at all) — torn by a crash mid-write on a non-atomic
+    filesystem, truncated, or bit-rotted. ``restore_latest`` treats it
+    as a FALLBACK trigger (warn + try the previous last-good
+    checkpoint, r13 satellite); an explicit ``restore(round)`` raises
+    it loudly — asking for a specific round is asking for exactly those
+    bytes."""
 
 
 class CheckpointWriteError(RuntimeError):
@@ -70,6 +88,14 @@ class CheckpointWriteError(RuntimeError):
 def _flatten(params: Any):
     leaves, treedef = jax.tree_util.tree_flatten(params)
     return leaves, treedef
+
+
+def _sha256_of(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 class Checkpointer:
@@ -105,12 +131,37 @@ class Checkpointer:
             # SPMD params are replicated; only process 0 writes (all
             # processes saving the same file to shared storage would race).
             return path
+        import io
+
         leaves, _ = _flatten(params)
         host_leaves = [np.asarray(x) for x in leaves]
+        # Serialize in memory so the sha256 comes from the SAME bytes
+        # in one pass — hashing the file after the write would re-read
+        # the whole npz from (possibly slow, shared) storage per save.
+        # (np.savez seeks backward to patch zip headers, so a straight
+        # tee-hash over the stream would hash the wrong bytes.)
+        buf = io.BytesIO()
+        np.savez(buf, *host_leaves)
+        data = buf.getvalue()
+        sha_hex = hashlib.sha256(data).hexdigest()
         tmp = path.with_suffix(".npz.tmp")
         with open(tmp, "wb") as f:
-            np.savez(f, *host_leaves)
+            f.write(data)
+        # Re-save ordering (the interrupt path re-saves rounds): the
+        # OLD sidecar must go before the new npz lands — a crash
+        # between the two renames then leaves new-bytes+NO-sidecar
+        # (legacy-tolerated by verify) instead of new-bytes+stale-hash
+        # (which would reject a perfectly good checkpoint on resume).
+        sha_path = path.with_suffix(".sha256")
+        sha_path.unlink(missing_ok=True)
         os.replace(tmp, path)
+        # Integrity sidecar (r13): verified on restore, so a checkpoint
+        # torn/corrupted AFTER the atomic rename (partial shared-
+        # storage sync, bit rot, truncation by another process) is
+        # detected instead of deserialized into garbage θ.
+        tmp_sha = sha_path.with_suffix(".sha256.tmp")
+        tmp_sha.write_text(sha_hex + "\n")
+        os.replace(tmp_sha, sha_path)
         meta = {"round": round_idx, "n_leaves": len(host_leaves)}
         meta_path = path.with_suffix(".json")
         tmp_meta = meta_path.with_suffix(".json.tmp")
@@ -190,6 +241,15 @@ class Checkpointer:
             )
             self._thread.start()
         self._queue.put((round_idx, params))
+
+    def busy(self) -> bool:
+        """True while the background writer still has work in flight —
+        the interrupt path checks this after a timed-out ``wait`` so a
+        synchronous save never races a stuck async write over the same
+        tmp/npz/sha files (two interleaved writers could produce a
+        corrupt npz whose sidecar validates the corrupt bytes)."""
+        q = self._queue
+        return q is not None and q.unfinished_tasks > 0
 
     def maybe_save_async(self, round_idx: int, params: Any) -> bool:
         """``save_async`` on the every-K cadence; True if a save was queued."""
@@ -288,6 +348,7 @@ class Checkpointer:
         for r in rounds[: -self.keep]:
             (self.dir / f"ckpt_{r:06d}.npz").unlink(missing_ok=True)
             (self.dir / f"ckpt_{r:06d}.json").unlink(missing_ok=True)
+            (self.dir / f"ckpt_{r:06d}.sha256").unlink(missing_ok=True)
 
     # -- restore -------------------------------------------------------------
 
@@ -319,30 +380,84 @@ class Checkpointer:
             r = int(multihost_utils.broadcast_one_to_all(np.int32(r)))
         return None if r < 0 else r
 
+    def verify(self, round_idx: int) -> None:
+        """Integrity-check round ``round_idx``'s checkpoint bytes
+        against its sha256 sidecar (r13) — raises
+        ``CheckpointIntegrityError`` on mismatch or an unreadable file.
+        A checkpoint WITHOUT a sidecar (pre-r13) passes: back-compat —
+        the parse errors a torn legacy file produces are still caught
+        by ``restore_latest``'s fallback. Primary-process concern; the
+        broadcast hands every other host verified bytes."""
+        path = self.dir / f"ckpt_{round_idx:06d}.npz"
+        sha_path = self.dir / f"ckpt_{round_idx:06d}.sha256"
+        if not path.exists():
+            raise CheckpointIntegrityError(
+                f"checkpoint round {round_idx}: {path.name} is missing"
+            )
+        if sha_path.exists():
+            want = sha_path.read_text().strip()
+            got = _sha256_of(path)
+            if got != want:
+                raise CheckpointIntegrityError(
+                    f"checkpoint round {round_idx}: sha256 mismatch "
+                    f"(disk {got[:12]}… != sidecar {want[:12]}…) — the "
+                    "file is torn or corrupt"
+                )
+
+    def _load_leaves(self, round_idx: int, template_leaves) -> list:
+        """Primary-side load + structural validation (shared by restore
+        and the restore_latest fallback scan). Parse failures surface
+        as ``CheckpointIntegrityError`` so a torn file and a sha
+        mismatch trigger the same fallback."""
+        path = self.dir / f"ckpt_{round_idx:06d}.npz"
+        self.verify(round_idx)
+        try:
+            with np.load(path) as data:
+                loaded = [
+                    data[f"arr_{i}"] for i in range(len(data.files))
+                ]
+        except CheckpointIntegrityError:
+            raise
+        except Exception as exc:  # torn/garbage npz — zipfile/pickle errs
+            raise CheckpointIntegrityError(
+                f"checkpoint round {round_idx}: unreadable npz "
+                f"({type(exc).__name__}: {exc})"
+            ) from exc
+        if len(loaded) != len(template_leaves):
+            raise ValueError(
+                f"checkpoint has {len(loaded)} leaves, template has "
+                f"{len(template_leaves)}"
+            )
+        for i, (got, want) in enumerate(zip(loaded, template_leaves)):
+            if got.shape != np.shape(want):
+                raise ValueError(
+                    f"leaf {i}: checkpoint shape {got.shape} != model "
+                    f"{np.shape(want)}"
+                )
+        return loaded
+
     def restore(self, round_idx: int, template: Any) -> Any:
         """Load round ``round_idx`` into the structure of ``template``.
 
         Multi-host: only process 0 reads the files (storage may not be
         shared or may lag); leaves are broadcast to every process, so all
-        hosts restore bit-identical params.
+        hosts restore bit-identical params. Integrity: the sha256
+        sidecar is verified before the parse — an explicit round
+        request raises ``CheckpointIntegrityError`` loudly (the
+        last-good fallback lives in ``restore_latest``).
         """
         leaves, treedef = _flatten(template)
-        if is_primary():
-            path = self.dir / f"ckpt_{round_idx:06d}.npz"
-            with np.load(path) as data:
-                loaded = [data[f"arr_{i}"] for i in range(len(data.files))]
-            if len(loaded) != len(leaves):
-                raise ValueError(
-                    f"checkpoint has {len(loaded)} leaves, template has {len(leaves)}"
-                )
-            for i, (got, want) in enumerate(zip(loaded, leaves)):
-                if got.shape != np.shape(want):
-                    raise ValueError(
-                        f"leaf {i}: checkpoint shape {got.shape} != model {np.shape(want)}"
-                    )
-        else:
+        loaded = self._load_leaves(round_idx, leaves) if is_primary() else None
+        return self._broadcast_loaded(loaded, leaves, treedef)
+
+    @staticmethod
+    def _broadcast_loaded(loaded, template_leaves, treedef):
+        """Primary's loaded leaf list (None elsewhere) → every host's
+        unflattened params (broadcast when multi-process)."""
+        if loaded is None:
             loaded = [
-                np.zeros(np.shape(x), dtype=np.asarray(x).dtype) for x in leaves
+                np.zeros(np.shape(x), dtype=np.asarray(x).dtype)
+                for x in template_leaves
             ]
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
@@ -353,8 +468,44 @@ class Checkpointer:
         )
 
     def restore_latest(self, template: Any) -> tuple[Any, int] | None:
-        """(params, round) of the newest checkpoint, or None if empty."""
-        r = self.latest_round()
-        if r is None:
+        """(params, round) of the newest LAST-GOOD checkpoint, or None.
+
+        r13: the scan walks newest → oldest and a checkpoint that fails
+        its sha256 sidecar (or cannot be parsed — the torn-file shape)
+        is WARNED about and skipped instead of crashing the resume, so
+        one corrupt file costs one checkpoint interval of progress, not
+        the run (``keep`` ≥ 2 retains the fallback target). Pod-wide
+        like ``latest_round``: process 0 decides the chosen round and
+        every host restores the same one — a host-local decision would
+        desync the SPMD collectives."""
+        leaves, treedef = _flatten(template)
+        r, loaded = -1, None
+        if is_primary():
+            for cand in sorted(self._rounds(), reverse=True):
+                try:
+                    loaded = self._load_leaves(cand, leaves)
+                except CheckpointIntegrityError as exc:
+                    import warnings
+
+                    from qfedx_tpu import obs
+
+                    obs.counter("checkpoint.corrupt_skipped")
+                    warnings.warn(
+                        f"skipping corrupt checkpoint (round {cand}): "
+                        f"{exc} — falling back to the previous "
+                        "last-good checkpoint",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+                    continue
+                r = cand
+                break
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            r = int(multihost_utils.broadcast_one_to_all(np.int32(r)))
+        if r < 0:
             return None
-        return self.restore(r, template), r
+        # The leaves the scan validated are the leaves restored — one
+        # read, one hash, no reread window for the file to rot in.
+        return self._broadcast_loaded(loaded, leaves, treedef), r

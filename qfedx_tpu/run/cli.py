@@ -103,6 +103,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(inf compiles no clip ops)")
     t.add_argument("--trim-fraction", type=float, default=0.1,
                    help="trimmed_mean per-end trim fraction (< 0.5)")
+    t.add_argument("--staleness-mode", default="constant",
+                   choices=["constant", "poly"],
+                   help="staleness discount family for buffered straggler "
+                        "waves (r13, QFEDX_STALE; streamed rounds): "
+                        "constant s(t)=alpha, poly s(t)=(1+t)^-alpha")
+    t.add_argument("--staleness-alpha", type=float, default=0.5,
+                   help="staleness discount parameter (see "
+                        "--staleness-mode)")
+    t.add_argument("--staleness-max-age", type=int, default=2,
+                   help="rounds a buffered straggler partial may lag "
+                        "before being discarded as dropouts")
     # run
     t.add_argument("--eval-every", type=int, default=1)
     t.add_argument("--rounds-per-call", type=int, default=None,
@@ -204,6 +215,9 @@ def config_from_args(a: argparse.Namespace) -> ExperimentConfig:
             aggregator=a.aggregator,
             clip_bound=a.clip_bound,
             trim_fraction=a.trim_fraction,
+            staleness_mode=a.staleness_mode,
+            staleness_alpha=a.staleness_alpha,
+            staleness_max_age=a.staleness_max_age,
         ),
         num_rounds=a.rounds,
         eval_every=a.eval_every,

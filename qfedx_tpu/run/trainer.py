@@ -12,6 +12,8 @@ checkpoint-every-K-rounds with resume (ROADMAP.md:90-91), and JSONL metrics
 
 from __future__ import annotations
 
+import signal
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -678,6 +680,7 @@ def train_federated_streamed(
     stream_depth: int | None = None,
     fault_plan=None,
     wave_deadline_s: float | None = None,
+    stale_poll_s: float = 30.0,
 ) -> TrainResult:
     """Federated training over a client REGISTRY — unbounded cohorts via
     hierarchical aggregation + streamed wave ingestion (the r10 tentpole).
@@ -752,8 +755,34 @@ def train_federated_streamed(
     subtracted (``secure_agg.unmatched_mask_sum`` — the r11 oracle,
     now production-consulted). Guards off keeps the r11 fail-fast
     ``StreamError``.
+
+    Staleness-aware buffering (r13 tentpole, ``QFEDX_STALE`` — default
+    off ⇒ the loop above bit-for-bit): a deadline-missed wave is a
+    STRAGGLER, not a casualty. The uploader finishes it in the
+    background (``data/stream`` ``on_wave_error="buffer"``), its
+    ``RoundPartial`` is computed against the ORIGIN round's θ, round
+    key and survivor/attack inputs, parks in a bounded staleness
+    buffer, and folds into a later round's apply discounted by s(τ)
+    (``cfg.staleness_mode``/``staleness_alpha``; τ = rounds of
+    lateness, capped by ``cfg.staleness_max_age`` — older stragglers
+    degrade to dropouts). Composition: per-wave secure-agg pair graphs
+    make every wave's partial self-cancelling (a stale wave lands in a
+    round whose other waves drew different graphs — lr=0 residual
+    pinned in tests/test_staleness.py); the DP accountant charged the
+    ORIGIN round at sampling time, so ε is invariant under any
+    lateness pattern (a stale apply is post-processing of
+    already-noised uploads); robust rules combine across the MIXED-AGE
+    partial stack. ``stale_poll_s`` bounds how long each round waits
+    for an outstanding straggler before carrying it forward.
+    Requires QFEDX_HIER + QFEDX_GUARDS, and ``wave_deadline_s`` to
+    actually classify lateness. Ledger: ``late_waves``,
+    ``stale_partials_applied``, ``stale_discarded_waves`` per
+    metrics.jsonl row. A SIGTERM or Ctrl-C drains the uploaders and
+    the async checkpoint writer and writes one final synchronous
+    checkpoint at the last completed round before propagating (the
+    graceful-shutdown contract, pinned in tests/test_stream.py).
     """
-    from qfedx_tpu.data.stream import DroppedWave, WaveStream
+    from qfedx_tpu.data.stream import DroppedWave, LateWave, WaveStream
     from qfedx_tpu.fed.round import (
         SA_KEY_SALT,
         RoundStats,
@@ -763,6 +792,7 @@ def train_federated_streamed(
         make_apply_partials,
         make_fed_round_partial,
         stack_partials,
+        stale_enabled,
     )
     from qfedx_tpu.fed.sampling import CohortSampler, participation_mask
     from qfedx_tpu.fed.secure_agg import unmatched_mask_sum
@@ -808,6 +838,45 @@ def train_federated_streamed(
             f"waves={num_waves} it would silently degenerate to plain "
             "masked mean — split the cohort or use clip_mean"
         )
+    # Staleness-aware buffered aggregation (r13, QFEDX_STALE — build
+    # time, default off = the exact r12 loop below): a wave that misses
+    # ``wave_deadline_s`` is no longer converted into casualties — the
+    # uploader finishes it in the background, its RoundPartial is
+    # computed against the ORIGIN round's θ/keys/survivors and parked,
+    # and a later round's apply folds it in with the staleness discount
+    # s(τ) (fed/round.make_apply_partials). Needs the hierarchy (a
+    # stale contribution IS a RoundPartial) and the guards (the buffer
+    # extends the r12 drop path).
+    stale = stale_enabled()
+    if stale and not hier:
+        raise ValueError(
+            "QFEDX_STALE needs the hierarchical round (QFEDX_HIER=on): "
+            "staleness buffering parks per-wave RoundPartials, which "
+            "the flat one-program round does not produce"
+        )
+    if stale and not guards:
+        raise ValueError(
+            "QFEDX_STALE needs QFEDX_GUARDS=on: a straggler wave that "
+            "dies for good degrades to survivor-mask dropouts, which "
+            "the unguarded round program cannot express"
+        )
+    if stale and wave_deadline_s is None:
+        # Not an error — a deadline-free stale run is well-defined
+        # (identical results to r12, per-wave pair graphs aside) and
+        # the parity tests rely on it (a finite deadline under cold
+        # compiles would mark waves spuriously late). But an OPERATOR
+        # pinning QFEDX_STALE without a deadline almost certainly
+        # expected buffering, so say out loud that nothing can ever be
+        # classified late.
+        import warnings
+
+        warnings.warn(
+            "QFEDX_STALE is on but wave_deadline_s is None: no wave "
+            "can be classified late, so staleness buffering is inert "
+            "— pass wave_deadline_s to salvage stragglers",
+            UserWarning,
+            stacklevel=2,
+        )
 
     sampler = CohortSampler(
         registry_size=registry.num_clients, cohort_size=cohort_size,
@@ -818,16 +887,23 @@ def train_federated_streamed(
             model, cfg, mesh, wave_clients=wave_size,
             cohort_clients=cohort_size,
         )
-        if robust:
-            # Non-additive rules: per-wave partials are STACKED and
-            # combined coordinate-wise at the hierarchy root — the
-            # cross-wave trim that bounds a fully-captured wave.
-            accum_fn = apply_fn = None
+        if robust or stale:
+            # Non-additive rules — and the staleness axis, whose
+            # discounted apply needs per-wave identity (ages) — STACK
+            # per-wave partials and combine them at the hierarchy root.
             apply_stacked_fn = make_apply_partials(cfg, cohort_size)
         else:
+            apply_stacked_fn = None
+        if robust:
+            accum_fn = apply_fn = None
+        else:
+            # Built under QFEDX_STALE too: a straggler-FREE round takes
+            # this exact sequential accumulate + apply (the r12
+            # programs), so stale-on changes no bit until a wave is
+            # actually late — the stacked discounted apply has a
+            # different summation order.
             accum_fn = make_accumulate_partial()
             apply_fn = make_apply_partial(cfg, cohort_size)
-            apply_stacked_fn = None
         round_fn = None
     else:
         partial_fn = accum_fn = apply_fn = apply_stacked_fn = None
@@ -896,254 +972,486 @@ def train_federated_streamed(
             metrics0 = evaluate(params, test_x, test_y)
         result.accuracies.append(metrics0["accuracy"])
 
-    for rnd in range(start_round, num_rounds):
-        t0 = time.perf_counter()
-        round_key = jax.random.fold_in(round_key_base, rnd)
-        cohort_ids = sampler.round_ids(rnd)
-        # The round's survivor mask, decided by the fault plan BEFORE
-        # any wave dispatches (the server learns who died; the mask is
-        # cohort-wide so every wave's pair graph agrees). None (no plan
-        # or no casualties) keeps the all-ones fast path — and the
-        # bit-parity with a plan-free run. The byzantine attack input
-        # (r12) rides the same seam: None when every client is honest.
-        surv = None
-        surv_np = None
-        byz = None
-        if plan is not None:
-            s_np = plan.survivors(rnd, cohort_ids)
-            if not np.all(s_np == 1.0):
-                from jax.sharding import NamedSharding, PartitionSpec
+    # Straggler salvage state (r13, QFEDX_STALE): streams from earlier
+    # rounds whose late waves are still uploading in the background.
+    # Bounded by staleness_max_age — every entry resolves (salvaged or
+    # abandoned) within that many rounds, and the loop's finally closes
+    # whatever a crash leaves behind.
+    pending_late: list = []
+    # Graceful shutdown (r13 satellite): SIGTERM is translated into
+    # KeyboardInterrupt (main thread only — signal handlers cannot be
+    # installed elsewhere), so an orchestrator's TERM drains exactly
+    # like a Ctrl-C: the wave uploaders and the async checkpoint writer
+    # are drained, ONE final synchronous checkpoint lands at the last
+    # completed round, and the interrupt still propagates — no
+    # daemon-thread hang, no torn metrics.jsonl row (the logger fsyncs
+    # whole lines), no silently-lost progress.
+    prev_sigterm = None
+    in_main = threading.current_thread() is threading.main_thread()
+    if in_main:
 
-                surv_np = s_np
-                surv = jax.device_put(
-                    s_np, NamedSharding(mesh, PartitionSpec())
-                )
-            byz = plan.byzantine_attack(rnd, cohort_ids)
-        stream = WaveStream(
-            registry, mesh, cohort_ids, wave_size, depth=stream_depth,
-            fault_plan=plan, round_idx=rnd,
-            # r12 satellite: with guards on, a wave past the retry/wave
-            # deadline converts into survivor-mask dropouts (handled
-            # below) instead of a fatal StreamError.
-            on_wave_error="drop" if guards else "raise",
-            wave_deadline_s=wave_deadline_s,
-        )
-        lost: list = []
+        def _on_sigterm(signum, frame):
+            raise KeyboardInterrupt("SIGTERM")
+
         try:
-            # Dispatch wall covers the whole wave fan-in: JAX's async
-            # dispatch returns before compute finishes, so the host
-            # loops ahead issuing wave w+1 while wave w runs — and the
-            # stream's background H2D staging overlaps both (the
-            # ingest.h2d / round.dispatch overlap the trace shows).
-            with obs.span(
-                "round.dispatch", round=rnd + 1, waves=num_waves,
-                cohort=cohort_size,
-            ) as sp_dispatch:
-                acc = None
-                parts: list = []
-                stats = None
-                for item in stream:
-                    if isinstance(item, DroppedWave):
-                        lost.append(item)
-                        continue
-                    wave_base, (wx, wy, wm) = item
-                    if hier:
-                        part = partial_fn(
-                            params, wx, wy, wm, np.int32(wave_base),
-                            round_key, survivors=surv, byzantine=byz,
-                        )
-                        if robust:
-                            parts.append(part)
-                        else:
-                            acc = (
-                                part if acc is None
-                                else accum_fn(acc, part)
-                            )
-                    else:
-                        params, stats = round_fn(
-                            params, wx, wy, wm, round_key,
-                            survivors=surv, byzantine=byz,
-                        )
-                if lost:
-                    # Fetch-dead waves become DROPOUTS (r12 satellite):
-                    # their effective clients are casualties the server
-                    # discovered too late to exclude from the pair
-                    # graphs the dispatched waves already drew — so
-                    # under cohort-graph secure-agg, regenerate the
-                    # casualties' unmatched masks and subtract them
-                    # (the r11 unmatched_mask_sum oracle, production-
-                    # consulted). Robust rules need no correction: with
-                    # masks their pair graphs are wave-local, without
-                    # masks there are no masks to recover.
-                    dead = np.zeros(cohort_size, dtype=np.float32)
-                    for dw in lost:
-                        dead[dw.wave_base:dw.wave_base + wave_size] = 1.0
-                    part_np = np.asarray(participation_mask(
-                        round_key, cohort_size, cfg.client_fraction
-                    ))
-                    surv_host = (
-                        surv_np if surv_np is not None
-                        else np.ones(cohort_size, dtype=np.float32)
-                    )
-                    eff_pre = part_np * surv_host
-                    # Casualties of a dead wave = its SAMPLED clients —
-                    # including any the fault plan had already marked
-                    # dropped: their wave never dispatched, so the
-                    # in-program dropped counter (which only sees
-                    # dispatched blocks) never counts them. eff_pre (the
-                    # survivor-masked set the dispatched waves' pair
-                    # graphs ran over) is for the mask correction below.
-                    n_lost = float((part_np * dead).sum())
-                    obs.counter("fed.dropped_waves", len(lost))
-                    if acc is not None and cfg.secure_agg:
-                        sa_key = jax.random.fold_in(
-                            round_key, SA_KEY_SALT
-                        )
-                        corr = unmatched_mask_sum(
-                            sa_key, cohort_size,
-                            trees.tree_zeros_like(params),
-                            jnp.asarray(eff_pre),
-                            jnp.asarray(eff_pre * (1.0 - dead)),
-                            cfg.secure_agg_scale,
-                            cfg.secure_agg_neighbors,
-                            cfg.secure_agg_mode,
-                        )
-                        acc = acc._replace(
-                            update_sum=trees.tree_add(
-                                acc.update_sum, corr
-                            )
-                        )
-                    if acc is not None:
-                        acc = acc._replace(
-                            dropped_clients=acc.dropped_clients + n_lost
-                        )
-                    elif parts:
-                        parts[-1] = parts[-1]._replace(
-                            dropped_clients=parts[-1].dropped_clients
-                            + n_lost
-                        )
-                if hier and robust and parts:
-                    params, stats = apply_stacked_fn(
-                        params, stack_partials(parts)
-                    )
-                elif hier and acc is not None:
-                    params, stats = apply_fn(params, acc)
-                if stats is None:
-                    # EVERY wave died (or the flat round's only wave
-                    # did): θ passes through untouched — the skipped-
-                    # round shape min_participation defines, decided
-                    # host-side because there is nothing to dispatch.
-                    n_lost = n_lost if lost else 0.0
-                    stats = RoundStats(
-                        mean_loss=np.float32(0.0),
-                        total_weight=np.float32(0.0),
-                        num_participants=np.float32(0.0),
-                        rejected_updates=np.float32(0.0),
-                        dropped_clients=np.float32(n_lost),
-                        applied=np.float32(0.0),
-                    )
-        finally:
-            stream.close()
-        with obs.span("round.fetch", round=rnd + 1) as sp_fetch:
-            stats_h = jax.device_get(stats)
-        dt = time.perf_counter() - t0
+            prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+        except (ValueError, OSError):  # exotic embeddings; run unguarded
+            in_main = False
+    last_done, last_params = start_round, params
+    try:
+        for rnd in range(start_round, num_rounds):
+            t0 = time.perf_counter()
+            round_key = jax.random.fold_in(round_key_base, rnd)
+            cohort_ids = sampler.round_ids(rnd)
+            # θ this round's waves train against — ALSO the origin θ a
+            # straggler wave's stale partial must be computed from (r13):
+            # a slow client's update is a gradient at the θ it downloaded,
+            # not at whatever θ exists when its upload finally lands.
+            params_in = params
+            # The round's survivor mask, decided by the fault plan BEFORE
+            # any wave dispatches (the server learns who died; the mask is
+            # cohort-wide so every wave's pair graph agrees). None (no plan
+            # or no casualties) keeps the all-ones fast path — and the
+            # bit-parity with a plan-free run. The byzantine attack input
+            # (r12) rides the same seam: None when every client is honest.
+            surv = None
+            surv_np = None
+            byz = None
+            if plan is not None:
+                s_np = plan.survivors(rnd, cohort_ids)
+                if not np.all(s_np == 1.0):
+                    from jax.sharding import NamedSharding, PartitionSpec
 
-        loss = float(np.asarray(stats_h.mean_loss))
-        result.round_times_s.append(dt)
-        result.losses.append(loss)
-        metrics = {
-            "round": rnd + 1,
-            "loss": loss,
-            "time_s": dt,
-            "cohort": cohort_size,
-            "waves": num_waves,
-            "participants": int(np.asarray(stats_h.num_participants)),
-        }
-        if guards:
-            # The casualty ledger (r11): exact per-round counts in the
-            # permanent record — dropped = sampled-but-died (survivor
-            # mask), rejected = non-finite updates quarantined in the
-            # round program; the chaos tests reconcile both against the
-            # fault plan. A min_participation skip is logged, never
-            # silent.
-            n_drop = int(round(float(np.asarray(stats_h.dropped_clients))))
-            n_rej = int(round(float(np.asarray(stats_h.rejected_updates))))
-            metrics["dropped_clients"] = n_drop
-            metrics["rejected_updates"] = n_rej
-            if n_drop:
-                obs.counter("fed.dropped_clients", n_drop)
-            if n_rej:
-                obs.counter("fed.rejected_updates", n_rej)
-            if lost:
-                metrics["dropped_waves"] = len(lost)
-            if float(np.asarray(stats_h.applied)) < 0.5:
-                metrics["skipped"] = True
-                obs.counter("fed.rounds_skipped")
-        if agg != "mean":
-            # Byzantine-defense ledger (r12): aggregator identity plus
-            # its per-round counters, exact — the chaos tests reconcile
-            # clipped_clients against the plan like the r11 casualty
-            # counts above.
-            metrics["aggregator"] = agg
-            if agg == "clip_mean":
-                n_clip = int(round(
-                    float(np.asarray(stats_h.clipped_clients))
-                ))
-                metrics["clipped_clients"] = n_clip
-                if n_clip:
-                    obs.counter("fed.clipped_clients", n_clip)
-            else:
-                metrics["trimmed_fraction"] = round(
-                    float(np.asarray(stats_h.trimmed_fraction)), 4
-                )
-        if accountant is not None:
-            # acct_q is a pure function of the SAMPLED cohort (set
-            # above, before the loop) — survivor counts never enter.
-            # Dropouts must not shrink the accounted q: the casualties
-            # were still selected by the mechanism's sampling step, so
-            # claiming a smaller q would overstate amplification;
-            # charging the full cohort is conservative
-            # (tests/test_faults.py pins ε dropout-invariant).
-            accountant.step(
-                q=acct_q, sigma=cfg.dp.noise_multiplier,
-                num_steps=acct_steps,
+                    surv_np = s_np
+                    surv = jax.device_put(
+                        s_np, NamedSharding(mesh, PartitionSpec())
+                    )
+                byz = plan.byzantine_attack(rnd, cohort_ids)
+            stream = WaveStream(
+                registry, mesh, cohort_ids, wave_size, depth=stream_depth,
+                fault_plan=plan, round_idx=rnd,
+                # r12 satellite: with guards on, a wave past the retry/wave
+                # deadline converts into survivor-mask dropouts (handled
+                # below) instead of a fatal StreamError. r13: with
+                # QFEDX_STALE it converts into a buffered STRAGGLER instead
+                # — the upload finishes in the background and the wave
+                # contributes to a later round at a staleness discount.
+                on_wave_error=(
+                    "buffer" if stale else "drop" if guards else "raise"
+                ),
+                wave_deadline_s=wave_deadline_s,
             )
-            eps = accountant.epsilon(cfg.dp.delta)
-            result.epsilons.append(eps)
-            metrics["epsilon"] = eps
-        sp_eval = None
-        if (rnd + 1) % eval_every == 0 or rnd == num_rounds - 1:
-            with obs.span("round.eval", round=rnd + 1) as sp_eval:
-                eval_metrics = evaluate(params, test_x, test_y)
-            result.accuracies.append(eval_metrics["accuracy"])
-            metrics.update(eval_metrics)
-        if checkpointer is not None:
-            with obs.span("round.checkpoint", round=rnd + 1):
-                if rnd == num_rounds - 1:
-                    checkpointer.wait()
-                    checkpointer.save(rnd + 1, params)
+            lost: list = []
+            late: list = []  # LateWave markers — stragglers, not casualties
+            stale_parts: list = []  # (origin_round, RoundPartial) folding in NOW
+            host_extra_dropped = 0.0  # casualties no dispatched partial carries
+            stale_discarded = 0  # over-age / dead stragglers given up this round
+            try:
+                # Dispatch wall covers the whole wave fan-in: JAX's async
+                # dispatch returns before compute finishes, so the host
+                # loops ahead issuing wave w+1 while wave w runs — and the
+                # stream's background H2D staging overlaps both (the
+                # ingest.h2d / round.dispatch overlap the trace shows).
+                with obs.span(
+                    "round.dispatch", round=rnd + 1, waves=num_waves,
+                    cohort=cohort_size,
+                ) as sp_dispatch:
+                    acc = None
+                    parts: list = []
+                    stats = None
+                    for item in stream:
+                        if isinstance(item, DroppedWave):
+                            lost.append(item)
+                            continue
+                        if isinstance(item, LateWave):
+                            # Straggler (r13): NOT a casualty — its upload
+                            # keeps running in the background and its
+                            # partial folds into a later round through the
+                            # staleness buffer (collected below next round).
+                            late.append(item)
+                            continue
+                        wave_base, (wx, wy, wm) = item
+                        if hier:
+                            part = partial_fn(
+                                params, wx, wy, wm, np.int32(wave_base),
+                                round_key, survivors=surv, byzantine=byz,
+                            )
+                            if robust or stale:
+                                parts.append(part)
+                            else:
+                                acc = (
+                                    part if acc is None
+                                    else accum_fn(acc, part)
+                                )
+                        else:
+                            params, stats = round_fn(
+                                params, wx, wy, wm, round_key,
+                                survivors=surv, byzantine=byz,
+                            )
+                    # r13: collect stragglers from EARLIER rounds whose
+                    # background uploads completed. Each one's RoundPartial
+                    # is computed against its ORIGIN round's θ, round key
+                    # and survivor/attack inputs — the update the slow
+                    # clients would have sent — then joins THIS round's
+                    # discounted apply, tagged with its age. A straggler
+                    # that died for good (or outlived staleness_max_age)
+                    # degrades to casualties, counted host-side because its
+                    # origin round has long been reported.
+                    if stale and pending_late:
+                        still_pending = []
+                        # ONE round-level salvage deadline shared by
+                        # every pending stream (they wait on the same
+                        # wall clock) — the round stalls at most
+                        # stale_poll_s total, not per straggler.
+                        poll_deadline = time.monotonic() + stale_poll_s
+                        for p in pending_late:
+                            age = rnd - p["round"]
+                            items, failed = p["stream"].poll_late(
+                                timeout_s=max(
+                                    0.0,
+                                    poll_deadline - time.monotonic(),
+                                )
+                            )
+                            for lo, (lwx, lwy, lwm) in items:
+                                spart = partial_fn(
+                                    p["params"], lwx, lwy, lwm,
+                                    np.int32(lo), p["key"],
+                                    survivors=p["surv"], byzantine=p["byz"],
+                                )
+                                stale_parts.append((p["round"], spart))
+                            dead_waves = list(failed)
+                            keep = p["stream"].late_pending()
+                            if keep and age >= cfg.staleness_max_age:
+                                # The bounded buffer: whatever has not
+                                # resolved by max age is given up on.
+                                dead_waves += p["stream"].abandon_late()
+                                keep = False
+                            if dead_waves:
+                                # Casualties of a dead straggler = its
+                                # SAMPLED clients — including any the
+                                # plan had already marked dropped: the
+                                # wave never dispatched in ANY round,
+                                # so no in-program counter ever saw
+                                # them (the same convention as the
+                                # fresh dead-wave path below; 'drop'
+                                # and 'buffer' must reconcile to the
+                                # same ledger totals for one plan).
+                                p_np = np.asarray(participation_mask(
+                                    p["key"], cohort_size,
+                                    cfg.client_fraction,
+                                ))
+                                for w in dead_waves:
+                                    host_extra_dropped += float(
+                                        p_np[
+                                            w * wave_size:(w + 1) * wave_size
+                                        ].sum()
+                                    )
+                                stale_discarded += len(dead_waves)
+                            if keep:
+                                still_pending.append(p)
+                            else:
+                                p["stream"].close()
+                        pending_late[:] = still_pending
+                    if lost:
+                        # Fetch-dead waves become DROPOUTS (r12 satellite):
+                        # their effective clients are casualties the server
+                        # discovered too late to exclude from the pair
+                        # graphs the dispatched waves already drew — so
+                        # under cohort-graph secure-agg, regenerate the
+                        # casualties' unmatched masks and subtract them
+                        # (the r11 unmatched_mask_sum oracle, production-
+                        # consulted). Robust rules need no correction: with
+                        # masks their pair graphs are wave-local, without
+                        # masks there are no masks to recover.
+                        dead = np.zeros(cohort_size, dtype=np.float32)
+                        for dw in lost:
+                            dead[dw.wave_base:dw.wave_base + wave_size] = 1.0
+                        part_np = np.asarray(participation_mask(
+                            round_key, cohort_size, cfg.client_fraction
+                        ))
+                        surv_host = (
+                            surv_np if surv_np is not None
+                            else np.ones(cohort_size, dtype=np.float32)
+                        )
+                        eff_pre = part_np * surv_host
+                        # Casualties of a dead wave = its SAMPLED clients —
+                        # including any the fault plan had already marked
+                        # dropped: their wave never dispatched, so the
+                        # in-program dropped counter (which only sees
+                        # dispatched blocks) never counts them. eff_pre (the
+                        # survivor-masked set the dispatched waves' pair
+                        # graphs ran over) is for the mask correction below.
+                        n_lost = float((part_np * dead).sum())
+                        obs.counter("fed.dropped_waves", len(lost))
+                        if stale:
+                            # Per-wave pair graphs (QFEDX_STALE): a dead
+                            # wave's masks never entered any other wave's
+                            # partial, so there is nothing to correct; its
+                            # casualties are counted host-side because the
+                            # round may have no dispatched partial to carry
+                            # them (every fresh wave late or dead).
+                            host_extra_dropped += n_lost
+                        else:
+                            if acc is not None and cfg.secure_agg:
+                                sa_key = jax.random.fold_in(
+                                    round_key, SA_KEY_SALT
+                                )
+                                corr = unmatched_mask_sum(
+                                    sa_key, cohort_size,
+                                    trees.tree_zeros_like(params),
+                                    jnp.asarray(eff_pre),
+                                    jnp.asarray(eff_pre * (1.0 - dead)),
+                                    cfg.secure_agg_scale,
+                                    cfg.secure_agg_neighbors,
+                                    cfg.secure_agg_mode,
+                                )
+                                acc = acc._replace(
+                                    update_sum=trees.tree_add(
+                                        acc.update_sum, corr
+                                    )
+                                )
+                            if acc is not None:
+                                acc = acc._replace(
+                                    dropped_clients=acc.dropped_clients
+                                    + n_lost
+                                )
+                            elif parts:
+                                parts[-1] = parts[-1]._replace(
+                                    dropped_clients=parts[-1].dropped_clients
+                                    + n_lost
+                                )
+                    if hier and stale:
+                        if stale_parts:
+                            # Mixed-age apply (r13): this round's fresh
+                            # partials plus the salvaged straggler
+                            # partials, each tagged with its age —
+                            # make_apply_partials discounts the stale
+                            # ones by s(τ) (and, under a robust rule,
+                            # combines across the mixed-age stack).
+                            all_parts = (
+                                parts + [sp for _o, sp in stale_parts]
+                            )
+                            ages = np.asarray(
+                                [0.0] * len(parts)
+                                + [float(rnd - o) for o, _sp in stale_parts],
+                                np.float32,
+                            )
+                            params, stats = apply_stacked_fn(
+                                params, stack_partials(all_parts), ages=ages
+                            )
+                        elif robust and parts:
+                            params, stats = apply_stacked_fn(
+                                params, stack_partials(parts)
+                            )
+                        elif parts:
+                            # Straggler-free round: the EXACT r12 apply
+                            # (sequential accumulate + undiscounted
+                            # apply — the stacked path sums in a
+                            # different order), so QFEDX_STALE changes
+                            # no bit until a wave is actually late
+                            # (tests/test_staleness.py).
+                            acc = parts[0]
+                            for extra in parts[1:]:
+                                acc = accum_fn(acc, extra)
+                            params, stats = apply_fn(params, acc)
+                    elif hier and robust and parts:
+                        params, stats = apply_stacked_fn(
+                            params, stack_partials(parts)
+                        )
+                    elif hier and acc is not None:
+                        params, stats = apply_fn(params, acc)
+                    if stats is None:
+                        # EVERY wave died (or the flat round's only wave
+                        # did): θ passes through untouched — the skipped-
+                        # round shape min_participation defines, decided
+                        # host-side because there is nothing to dispatch.
+                        # (Under QFEDX_STALE a fully-late round lands here
+                        # too — its waves contribute LATER, this round just
+                        # has nothing to apply; lost-wave casualties are
+                        # already in host_extra_dropped.)
+                        n_lost = 0.0 if (stale or not lost) else n_lost
+                        stats = RoundStats(
+                            mean_loss=np.float32(0.0),
+                            total_weight=np.float32(0.0),
+                            num_participants=np.float32(0.0),
+                            rejected_updates=np.float32(0.0),
+                            dropped_clients=np.float32(n_lost),
+                            applied=np.float32(0.0),
+                        )
+            finally:
+                if stale and stream.late_pending():
+                    # Straggler salvage in flight: keep the stream (and its
+                    # background uploader) alive on the pending list — the
+                    # next rounds' salvage step collects or abandons it.
+                    # Every pending stream is closed by the loop's outer
+                    # finally, so a crash cannot leak uploader threads.
+                    pending_late.append(dict(
+                        round=rnd, stream=stream, params=params_in,
+                        key=round_key, surv=surv, byz=byz,
+                    ))
                 else:
-                    # Background writer (r09): the device→host snapshot
-                    # + atomic tmp/rename happen off the round loop, so
-                    # a checkpoint boundary doesn't stall the wave
-                    # stream; the final save above stays synchronous
-                    # behind wait() for durability/error surfacing.
-                    checkpointer.maybe_save_async(rnd + 1, params)
-        if obs.enabled():
-            phases = {
-                "dispatch_s": round(sp_dispatch.duration, 6),
-                "fetch_s": round(sp_fetch.duration, 6),
-            }
-            if sp_dispatch.compile_s > 0:
-                phases["compile_s"] = round(sp_dispatch.compile_s, 6)
-            if sp_eval is not None:
-                phases["eval_s"] = round(sp_eval.duration, 6)
-            metrics["phases"] = phases
-            mem = obs.record_device_memory()
-            if mem and "bytes_in_use" in mem:
-                metrics["mem_bytes_in_use"] = mem["bytes_in_use"]
-        if on_round_end is not None:
-            on_round_end(rnd, metrics)
+                    stream.close()
+            with obs.span("round.fetch", round=rnd + 1) as sp_fetch:
+                stats_h = jax.device_get(stats)
+            dt = time.perf_counter() - t0
 
+            loss = float(np.asarray(stats_h.mean_loss))
+            result.round_times_s.append(dt)
+            result.losses.append(loss)
+            metrics = {
+                "round": rnd + 1,
+                "loss": loss,
+                "time_s": dt,
+                "cohort": cohort_size,
+                "waves": num_waves,
+                "participants": int(np.asarray(stats_h.num_participants)),
+            }
+            if guards:
+                # The casualty ledger (r11): exact per-round counts in the
+                # permanent record — dropped = sampled-but-died (survivor
+                # mask), rejected = non-finite updates quarantined in the
+                # round program; the chaos tests reconcile both against the
+                # fault plan. A min_participation skip is logged, never
+                # silent.
+                n_drop = int(round(
+                    float(np.asarray(stats_h.dropped_clients))
+                    + host_extra_dropped
+                ))
+                n_rej = int(round(float(np.asarray(stats_h.rejected_updates))))
+                metrics["dropped_clients"] = n_drop
+                metrics["rejected_updates"] = n_rej
+                if n_drop:
+                    obs.counter("fed.dropped_clients", n_drop)
+                if n_rej:
+                    obs.counter("fed.rejected_updates", n_rej)
+                if lost:
+                    metrics["dropped_waves"] = len(lost)
+                if float(np.asarray(stats_h.applied)) < 0.5:
+                    metrics["skipped"] = True
+                    obs.counter("fed.rounds_skipped")
+            if stale:
+                # The staleness ledger (r13): how many waves went late this
+                # round (their work lands later), how many buffered partials
+                # folded into THIS round's apply, and how many stragglers
+                # were given up on — exact counts, reconciled against the
+                # fault plan by the straggler chaos test like the r11/r12
+                # ledgers above.
+                metrics["late_waves"] = len(late)
+                metrics["stale_partials_applied"] = len(stale_parts)
+                if late:
+                    obs.counter("fed.late_waves", len(late))
+                if stale_parts:
+                    obs.counter(
+                        "fed.stale_partials_applied", len(stale_parts)
+                    )
+                if stale_discarded:
+                    metrics["stale_discarded_waves"] = stale_discarded
+                    obs.counter(
+                        "fed.stale_discarded_waves", stale_discarded
+                    )
+            if agg != "mean":
+                # Byzantine-defense ledger (r12): aggregator identity plus
+                # its per-round counters, exact — the chaos tests reconcile
+                # clipped_clients against the plan like the r11 casualty
+                # counts above.
+                metrics["aggregator"] = agg
+                if agg == "clip_mean":
+                    n_clip = int(round(
+                        float(np.asarray(stats_h.clipped_clients))
+                    ))
+                    metrics["clipped_clients"] = n_clip
+                    if n_clip:
+                        obs.counter("fed.clipped_clients", n_clip)
+                else:
+                    metrics["trimmed_fraction"] = round(
+                        float(np.asarray(stats_h.trimmed_fraction)), 4
+                    )
+            if accountant is not None:
+                # acct_q is a pure function of the SAMPLED cohort (set
+                # above, before the loop) — survivor counts never enter.
+                # Dropouts must not shrink the accounted q: the casualties
+                # were still selected by the mechanism's sampling step, so
+                # claiming a smaller q would overstate amplification;
+                # charging the full cohort is conservative
+                # (tests/test_faults.py pins ε dropout-invariant).
+                accountant.step(
+                    q=acct_q, sigma=cfg.dp.noise_multiplier,
+                    num_steps=acct_steps,
+                )
+                eps = accountant.epsilon(cfg.dp.delta)
+                result.epsilons.append(eps)
+                metrics["epsilon"] = eps
+            sp_eval = None
+            if (rnd + 1) % eval_every == 0 or rnd == num_rounds - 1:
+                with obs.span("round.eval", round=rnd + 1) as sp_eval:
+                    eval_metrics = evaluate(params, test_x, test_y)
+                result.accuracies.append(eval_metrics["accuracy"])
+                metrics.update(eval_metrics)
+            if checkpointer is not None:
+                with obs.span("round.checkpoint", round=rnd + 1):
+                    if rnd == num_rounds - 1:
+                        checkpointer.wait()
+                        checkpointer.save(rnd + 1, params)
+                    else:
+                        # Background writer (r09): the device→host snapshot
+                        # + atomic tmp/rename happen off the round loop, so
+                        # a checkpoint boundary doesn't stall the wave
+                        # stream; the final save above stays synchronous
+                        # behind wait() for durability/error surfacing.
+                        checkpointer.maybe_save_async(rnd + 1, params)
+            if obs.enabled():
+                phases = {
+                    "dispatch_s": round(sp_dispatch.duration, 6),
+                    "fetch_s": round(sp_fetch.duration, 6),
+                }
+                if sp_dispatch.compile_s > 0:
+                    phases["compile_s"] = round(sp_dispatch.compile_s, 6)
+                if sp_eval is not None:
+                    phases["eval_s"] = round(sp_eval.duration, 6)
+                metrics["phases"] = phases
+                mem = obs.record_device_memory()
+                if mem and "bytes_in_use" in mem:
+                    metrics["mem_bytes_in_use"] = mem["bytes_in_use"]
+            if on_round_end is not None:
+                on_round_end(rnd, metrics)
+
+            last_done, last_params = rnd + 1, params
+    except (KeyboardInterrupt, SystemExit):
+        # Drain, persist, re-raise: the streams are already closed (the
+        # per-round finally ran; parked ones close below), queued async
+        # checkpoint writes flush (bounded — a hung filesystem must not
+        # turn a TERM into a freeze), and the last COMPLETED round's θ
+        # is written synchronously so a resume loses at most the round
+        # the signal interrupted.
+        if checkpointer is not None:
+            try:
+                checkpointer.wait(raise_errors=False, timeout=30.0)
+                # A timed-out wait leaves the daemon writer mid-save;
+                # racing it with a synchronous save of the same round
+                # could interleave two writers on one tmp/npz/sha set
+                # and produce a corrupt checkpoint whose sidecar
+                # VALIDATES the corruption — skip the final save
+                # instead (wait already warned the operator).
+                if last_done > start_round and not checkpointer.busy():
+                    checkpointer.save(last_done, last_params)
+            except Exception:  # noqa: BLE001 — unwind path stays silent
+                pass
+        raise
+    finally:
+        for p in pending_late:
+            try:
+                p["stream"].close()
+            except Exception:  # noqa: BLE001 — best-effort unwind
+                pass
+        pending_late.clear()
+        if in_main:
+            try:
+                signal.signal(
+                    signal.SIGTERM,
+                    prev_sigterm if prev_sigterm is not None
+                    else signal.SIG_DFL,
+                )
+            except (ValueError, TypeError, OSError):
+                pass
     result.params = params
     return result

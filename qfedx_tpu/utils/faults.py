@@ -36,6 +36,17 @@ test harness):
   variant); ``label_flip`` is applied by the WaveStream to the fetched
   batch (``label_flips``). The DEFENSE is ``FedConfig.aggregator``
   (clip_mean / trimmed_mean / median — docs/ROBUSTNESS.md).
+- ``client.slow`` — per-(round, client) STRAGGLERS (r13): ``kind``
+  ``slow:s`` (seconds; bare ``slow`` = 1 s) marks a client slow — the
+  WaveStream uploader sleeps the wave's max slow-client seconds before
+  fetching it, so a slow client holds up exactly its wave. Past the
+  consumer's ``wave_deadline_s`` the wave goes late: a casualty under
+  ``on_wave_error="drop"``, a buffered stale contribution under
+  ``"buffer"`` (QFEDX_STALE, docs/ROBUSTNESS.md).
+- ``wave.delay`` — the same straggle injected per (round, wave):
+  ``kind`` ``delay:s`` sleeps the whole wave's upload ``s`` seconds.
+  The wave-granular dial the straggler bench/chaos tests drive
+  (``rate`` draws the per-(round, wave) coin, like the error sites).
 - ``registry.fetch`` — transient error raised inside the WaveStream
   uploader's fetch, before the registry is read (data/stream retries).
 - ``ingest.h2d`` — same, between host batch and ``device_put``.
@@ -91,13 +102,24 @@ SITES = (
     # hash coordinates of every pre-r12 site — and therefore every
     # pinned plan draw — must not move.
     "client.byzantine",
+    # r13 straggler sites (appended for the same reason).
+    "client.slow",
+    "wave.delay",
 )
 CLIENT_KINDS = ("drop", "nan", "inf")
 # Byzantine base kinds; scale REQUIRES a parameter ("scale:100"), noise
 # takes an optional σ ("noise" = σ 1.0, "noise:5" = σ 5).
 BYZANTINE_KINDS = ("scale", "sign_flip", "noise", "label_flip")
-_PER_CLIENT_SITES = ("client.compute", "client.byzantine")
-_ERROR_SITES = tuple(s for s in SITES if s not in _PER_CLIENT_SITES)
+# Straggler kinds (r13): slow takes optional seconds ("slow" = 1 s,
+# "slow:0.5"); delay REQUIRES them ("delay:0.5").
+SLOW_KINDS = ("slow",)
+_PER_CLIENT_SITES = ("client.compute", "client.byzantine", "client.slow")
+# wave.delay is neither per-client nor an error site: it returns a
+# DURATION (wave_delay_s) instead of raising, so check() rejects it.
+_ERROR_SITES = tuple(
+    s for s in SITES
+    if s not in _PER_CLIENT_SITES and s != "wave.delay"
+)
 
 
 def doc_taxonomy() -> dict[str, tuple[str, ...]]:
@@ -109,6 +131,8 @@ def doc_taxonomy() -> dict[str, tuple[str, ...]]:
     kinds = {
         "client.compute": CLIENT_KINDS,
         "client.byzantine": ("scale:k", "sign_flip", "noise", "label_flip"),
+        "client.slow": ("slow:s",),
+        "wave.delay": ("delay:s",),
     }
     return {s: kinds.get(s, ("error",)) for s in SITES}
 
@@ -206,6 +230,32 @@ class _Rule:
             if base == "noise" and not self.kind_param > 0:
                 raise ValueError(f"noise sigma must be > 0, got {self.kind!r}")
             self.kind = base
+        elif self.site == "client.slow":
+            base, _, param = str(self.kind).partition(":")
+            if base != "slow":
+                raise ValueError(
+                    f"client.slow kind {self.kind!r}: expected 'slow' "
+                    "or 'slow:seconds' (e.g. 'slow:0.5')"
+                )
+            self.kind_param = float(param) if param else 1.0
+            if not self.kind_param > 0:
+                raise ValueError(
+                    f"slow seconds must be > 0, got {self.kind!r}"
+                )
+            self.kind = base
+        elif self.site == "wave.delay":
+            base, _, param = str(self.kind).partition(":")
+            if base != "delay" or not param:
+                raise ValueError(
+                    f"wave.delay kind {self.kind!r}: needs "
+                    "'delay:seconds' (e.g. 'delay:0.5')"
+                )
+            self.kind_param = float(param)
+            if not self.kind_param > 0:
+                raise ValueError(
+                    f"delay seconds must be > 0, got {self.kind!r}"
+                )
+            self.kind = base
         elif self.kind != "error":
             raise ValueError(
                 f"{self.site} supports only kind='error', got {self.kind!r}"
@@ -215,6 +265,33 @@ class _Rule:
             None if spec.get("clients") is None
             else np.asarray(spec["clients"], dtype=np.int64)
         )
+        if self.site == "wave.delay" and self.clients is not None:
+            # Accepting-but-ignoring a clients list would be the
+            # wrong-thing-measured error class the loud grammar exists
+            # to prevent.
+            raise ValueError(
+                "wave.delay is per-(round, wave): restrict with "
+                "'rounds'/'waves'/'rate', not 'clients' — "
+                "client-granular straggle is the client.slow site"
+            )
+        if self.site == "client.slow" and spec.get("waves") is not None:
+            # Per-client draws pin wave=0 (a client exists independent
+            # of wave layout), so a 'waves' restriction would silently
+            # never fire — same accept-but-ignore class as above.
+            raise ValueError(
+                "client.slow draws per (round, client): restrict with "
+                "'rounds'/'clients'/'rate', not 'waves' — "
+                "wave-granular straggle is the wave.delay site"
+            )
+        if (
+            self.site in ("client.slow", "wave.delay")
+            and spec.get("times") is not None
+        ):
+            raise ValueError(
+                f"{self.site} injects a DURATION, not a retryable "
+                "error — 'times' (the retry-attempt bound) does not "
+                "apply"
+            )
         if self.site in _PER_CLIENT_SITES:
             if (self.rate is None) == (self.clients is None):
                 raise ValueError(
@@ -397,6 +474,59 @@ class FaultPlan:
         if np.all(mult == 1.0) and np.all(sigma == 0.0):
             return None
         return np.stack([mult, sigma], axis=1).astype(np.float32)
+
+    # -- straggler sites (client.slow / wave.delay, r13) ---------------------
+
+    def slow_seconds(self, round_idx: int, cohort_ids) -> np.ndarray:
+        """[len(cohort_ids)] float32 seconds: 0 = prompt client; where a
+        ``slow``/``slow:s`` rule fires, the client is a STRAGGLER — the
+        WaveStream delays its wave by the wave's max slow seconds
+        (largest s wins when rules overlap)."""
+        out = np.zeros(len(np.asarray(cohort_ids)), dtype=np.float32)
+        for rule, hit in self._rule_hits(
+            "client.slow", SLOW_KINDS, "slow", round_idx, cohort_ids
+        ):
+            out[hit] = np.maximum(out[hit], np.float32(rule.kind_param))
+        return out
+
+    def wave_delay_s(self, round_idx: int, wave: int) -> float:
+        """Injected upload delay (seconds) for one (round, wave) from
+        ``wave.delay`` rules — per-coordinate coin like ``check``'s,
+        salted per rule position; largest firing delay wins."""
+        delay = 0.0
+        for idx, rule in enumerate(self.rules):
+            if rule.site != "wave.delay" or not rule.applies(
+                round_idx, wave
+            ):
+                continue
+            u = _uniform(
+                self.seed + 7919 * (idx + 1), "wave.delay", round_idx,
+                wave, [0],
+            )[0]
+            if u < float(rule.rate):
+                delay = max(delay, float(rule.kind_param))
+        return delay
+
+    def wave_delays(
+        self, round_idx: int, cohort_ids, wave_size: int
+    ) -> np.ndarray:
+        """[num_waves] float32 seconds of injected straggle per wave:
+        the max of the wave's ``wave.delay`` draw and its slowest
+        ``client.slow`` member — the ONE number the WaveStream sleeps
+        before fetching each wave, and the oracle the straggler chaos
+        tests reconcile late-wave counts against."""
+        ids = np.asarray(cohort_ids)
+        wave_size = int(wave_size)
+        num_waves = len(ids) // wave_size
+        slow = self.slow_seconds(round_idx, ids)
+        out = np.zeros(num_waves, dtype=np.float32)
+        for w in range(num_waves):
+            blk = slow[w * wave_size:(w + 1) * wave_size]
+            out[w] = max(
+                float(blk.max()) if len(blk) else 0.0,
+                self.wave_delay_s(round_idx, w),
+            )
+        return out
 
     # -- error sites ---------------------------------------------------------
 

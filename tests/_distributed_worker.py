@@ -77,7 +77,21 @@ def main() -> None:
     from qfedx_tpu.fed.round import make_fed_round
     from qfedx_tpu.models.vqc import make_vqc_classifier
 
-    if mode == "byzantine":
+    if mode == "stale":
+        # r13: the staleness-discounted apply over REAL cross-process
+        # collectives. QFEDX_STALE pins per-wave secure-agg pair graphs
+        # at BUILD time (each wave's partial self-cancels — the
+        # property that lets one wave arrive a round late), wave 1 is
+        # treated as the straggler (age 1), and make_apply_partials
+        # folds the mixed-age stack with the constant discount. The
+        # parent compares against the identical computation on the
+        # virtual single-process mesh.
+        os.environ["QFEDX_STALE"] = "1"
+        num_clients, samples, n_q = 4, 8, 3
+        cfg = FedConfig(local_epochs=2, batch_size=4, learning_rate=0.1,
+                        optimizer="sgd", secure_agg=True,
+                        secure_agg_mode="ring")
+    elif mode == "byzantine":
         # r12: same 2-wave hier shape, attacker on process 1, clip_mean
         # defense (composes with the cohort-wide ring graph — the
         # robust rules' per-wave graphs are pinned single-process in
@@ -125,11 +139,13 @@ def main() -> None:
     )
     key = globalize(np.asarray(jax.random.PRNGKey(42)), P())
 
-    if mode in ("hier", "dropout", "byzantine"):
+    if mode in ("hier", "dropout", "byzantine", "stale"):
         from qfedx_tpu.fed.round import (
             make_accumulate_partial,
             make_apply_partial,
+            make_apply_partials,
             make_fed_round_partial,
+            stack_partials,
         )
 
         survivors = None
@@ -177,6 +193,7 @@ def main() -> None:
         )
         accum = make_accumulate_partial()
         acc = None
+        parts = []
         for w in range(num_clients // wave):
             sl = slice(w * wave, (w + 1) * wave)
             wx = globalize(cx[sl], P("clients"))
@@ -185,8 +202,18 @@ def main() -> None:
             wb = globalize(np.asarray(w * wave, dtype=np.int32), P())
             part = partial_fn(params, wx, wy, wm, wb, key,
                               survivors=survivors, byzantine=byz)
+            parts.append(part)
             acc = part if acc is None else accum(acc, part)
-        new_params, stats = make_apply_partial()(params, acc)
+        if mode == "stale":
+            # Wave 1 lands ONE ROUND LATE: the mixed-age discounted
+            # apply runs over cross-process partials (per-wave pair
+            # graphs — QFEDX_STALE was pinned before the build above).
+            new_params, stats = make_apply_partials(cfg, num_clients)(
+                params, stack_partials(parts),
+                ages=np.array([0.0, 1.0], np.float32),
+            )
+        else:
+            new_params, stats = make_apply_partial()(params, acc)
     else:
         scx = globalize(cx, P("clients"))
         scy = globalize(cy, P("clients"))

@@ -274,3 +274,76 @@ def test_two_process_dropout_spans_process_boundary(tmp_path):
         got["mean_loss"], np.asarray(ref_stats.mean_loss), atol=1e-5
     )
     assert float(got["total_weight"]) == float(ref_stats.total_weight)
+
+
+@pytest.mark.slow
+def test_two_process_stale_discounted_apply_matches_single_process(tmp_path):
+    """r13 parity over REAL cross-process collectives: the worker pair
+    builds QFEDX_STALE partials (per-wave secure-agg pair graphs — the
+    self-cancelling construction a buffered straggler needs) for both
+    waves and applies them through ``make_apply_partials`` with wave 1
+    tagged ONE ROUND STALE (constant discount 0.5). The oracle is the
+    identical mixed-age computation on the virtual single-process mesh
+    — the discounted apply, the wave-restricted masks and their
+    cancellation must all survive the process boundary (wave-split
+    tolerance, tests/test_hier.py rationale)."""
+    got = _run_workers(str(tmp_path / "dist_stale_result.npz"), "stale")
+
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.fed.round import (
+        client_mesh,
+        make_apply_partials,
+        make_fed_round_partial,
+        shard_client_data,
+        stack_partials,
+    )
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    num_clients, samples, n_q = 4, 8, 3
+    cfg = FedConfig(local_epochs=2, batch_size=4, learning_rate=0.1,
+                    optimizer="sgd", secure_agg=True,
+                    secure_agg_mode="ring")
+    model = make_vqc_classifier(n_qubits=n_q, n_layers=2, num_classes=2)
+    rng = np.random.default_rng(0)
+    cx = rng.uniform(0, 1, (num_clients, samples, n_q)).astype(np.float32)
+    cy = rng.integers(0, 2, (num_clients, samples)).astype(np.int32)
+    cm = np.ones((num_clients, samples), dtype=np.float32)
+    mesh = client_mesh(num_devices=2)
+    params = model.init(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(42)
+
+    import os as _os
+
+    prev = _os.environ.get("QFEDX_STALE")
+    _os.environ["QFEDX_STALE"] = "1"
+    try:
+        pf = make_fed_round_partial(
+            model, cfg, mesh, wave_clients=2, cohort_clients=num_clients
+        )
+        parts = []
+        for w in range(2):
+            sl = slice(w * 2, (w + 1) * 2)
+            wx, wy, wm = shard_client_data(
+                mesh, cx[sl], cy[sl], jnp.asarray(cm[sl])
+            )
+            parts.append(pf(params, wx, wy, wm, np.int32(w * 2), key))
+        ref_params, ref_stats = make_apply_partials(cfg, num_clients)(
+            params, stack_partials(parts),
+            ages=np.array([0.0, 1.0], np.float32),
+        )
+    finally:
+        if prev is None:
+            _os.environ.pop("QFEDX_STALE", None)
+        else:
+            _os.environ["QFEDX_STALE"] = prev
+
+    ref_leaves = jax.tree.leaves(ref_params)
+    assert len(ref_leaves) == sum(1 for k in got.files if k.startswith("leaf"))
+    for i, ref in enumerate(ref_leaves):
+        np.testing.assert_allclose(
+            got[f"leaf{i}"], np.asarray(ref), atol=2e-5, rtol=0
+        )
+    np.testing.assert_allclose(
+        got["mean_loss"], np.asarray(ref_stats.mean_loss), atol=1e-5
+    )
+    assert float(got["total_weight"]) == float(ref_stats.total_weight)

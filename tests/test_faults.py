@@ -455,3 +455,124 @@ def test_twenty_rounds_ten_percent_casualties_within_noise():
     )
     assert total > 10  # the plan actually fired ~10%/round
     assert chaos.final_accuracy > clean.final_accuracy - 0.1
+
+
+# --- straggler sites (client.slow / wave.delay, r13) ------------------------
+
+
+def test_straggler_plan_kinds_params_and_determinism():
+    """client.slow / wave.delay: parameterized kinds parse, draws are
+    pure in (seed, round, ids/wave), and wave_delays composes the two
+    sites into the per-wave sleep the stream actually performs."""
+    plan = FaultPlan(seed=6, rules=[
+        {"site": "client.slow", "kind": "slow:0.5", "clients": [6]},
+        {"site": "client.slow", "kind": "slow", "clients": [6]},  # 1 s wins
+        {"site": "wave.delay", "kind": "delay:0.25", "rounds": [1],
+         "waves": [0]},
+    ])
+    ids = np.arange(8)
+    slow = plan.slow_seconds(0, ids)
+    assert slow[6] == 1.0 and slow.sum() == 1.0  # overlapping rules: max
+    np.testing.assert_array_equal(slow, plan.slow_seconds(0, ids))
+    assert plan.wave_delay_s(1, 0) == 0.25
+    assert plan.wave_delay_s(0, 0) == 0.0  # round-restricted
+    # wave_delays = max(wave rule, slowest client in the wave)
+    np.testing.assert_allclose(
+        plan.wave_delays(1, ids, 4), [0.25, 1.0]
+    )
+    np.testing.assert_allclose(plan.wave_delays(0, ids, 4), [0.0, 1.0])
+    # grammar is loud
+    with pytest.raises(ValueError, match="slow"):
+        FaultPlan(rules=[{"site": "client.slow", "kind": "fast",
+                          "clients": [1]}])
+    with pytest.raises(ValueError, match="delay:seconds"):
+        FaultPlan(rules=[{"site": "wave.delay", "kind": "delay"}])
+    with pytest.raises(ValueError, match="> 0"):
+        FaultPlan(rules=[{"site": "wave.delay", "kind": "delay:0"}])
+    with pytest.raises(ValueError, match="exactly one"):
+        FaultPlan(rules=[{"site": "client.slow", "kind": "slow:1"}])
+    # wave.delay has no client axis — a clients key must fail loudly,
+    # never be silently ignored (rate would default to 1.0)
+    with pytest.raises(ValueError, match="client.slow"):
+        FaultPlan(rules=[{"site": "wave.delay", "kind": "delay:1",
+                          "clients": [3]}])
+    # ...and client.slow has no wave axis (per-client draws pin
+    # wave=0, so a waves restriction would silently never fire)
+    with pytest.raises(ValueError, match="wave.delay"):
+        FaultPlan(rules=[{"site": "client.slow", "kind": "slow:1",
+                          "clients": [3], "waves": [1]}])
+    # duration sites have no retry attempts for 'times' to bound
+    with pytest.raises(ValueError, match="times"):
+        FaultPlan(rules=[{"site": "wave.delay", "kind": "delay:1",
+                          "times": 1}])
+    with pytest.raises(ValueError, match="times"):
+        FaultPlan(rules=[{"site": "client.slow", "kind": "slow:1",
+                          "clients": [3], "times": 1}])
+    # wave.delay is a duration site, not an error site
+    with pytest.raises(ValueError, match="unknown error site"):
+        plan.check("wave.delay", 0)
+
+
+def test_chaos_smoke_straggler_run(tmp_path, monkeypatch):
+    """The r13 tier-1 chaos smoke: a streamed run under QFEDX_STALE
+    with a mixed plan — client 3 drops every round, client 6 is SLOW
+    (its wave goes late every round, salvaged the next) — must
+    complete, converge, keep theta finite, and reconcile the EXACT
+    staleness ledger (late_waves / stale_partials_applied /
+    dropped_clients) against the plan's wave_delays oracle per round."""
+    import jax
+
+    from qfedx_tpu.data.stream import ArrayRegistry
+    from qfedx_tpu.run.metrics import MetricsLogger
+    from qfedx_tpu.run.trainer import train_federated_streamed
+
+    monkeypatch.setenv("QFEDX_STALE", "1")
+    rng = np.random.default_rng(7)
+    C, S = 8, 16
+    cx = rng.uniform(0, 1, (C, S, N_Q)).astype(np.float32)
+    cy = (cx.mean(axis=2) > 0.5).astype(np.int32)
+    cm = np.ones((C, S), dtype=np.float32)
+    tx = rng.uniform(0, 1, (64, N_Q)).astype(np.float32)
+    ty = (tx.mean(axis=1) > 0.5).astype(np.int32)
+    model = make_vqc_classifier(n_qubits=N_Q, n_layers=2, num_classes=2)
+    cfg = FedConfig(local_epochs=2, batch_size=8, learning_rate=0.1,
+                    optimizer="adam", secure_agg=True,
+                    secure_agg_mode="ring")
+    plan = FaultPlan(seed=0, rules=[
+        {"site": "client.compute", "kind": "drop", "clients": [3]},
+        {"site": "client.slow", "kind": "slow:0.4", "clients": [6]},
+    ])
+    mesh = client_mesh(num_devices=4)
+    logger = MetricsLogger(tmp_path / "metrics.jsonl")
+    num_rounds = 6
+    res = train_federated_streamed(
+        model, cfg, ArrayRegistry(cx, cy, cm), tx, ty,
+        cohort_size=C, wave_size=4, num_rounds=num_rounds, seed=2,
+        eval_every=2, mesh=mesh, fault_plan=plan,
+        wave_deadline_s=0.1, stale_poll_s=15.0,
+        on_round_end=lambda r, m: logger.log(m),
+    )
+    logger.close()
+    for leaf in jax.tree.leaves(res.params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+    assert all(np.isfinite(res.losses))
+    # converged: the straggler's work keeps LANDING (discounted), so
+    # chaos costs accuracy little
+    assert res.final_accuracy > 0.7
+    rows = [
+        json.loads(line)
+        for line in (tmp_path / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert len(rows) == num_rounds
+    for r, row in enumerate(rows):
+        want_late = int((plan.wave_delays(r, np.arange(C), 4) > 0).sum())
+        assert row["late_waves"] == want_late == 1
+        # client 6's wave (ids 4..7) is salvaged one round late, every
+        # round after the first; the final round's straggler is still
+        # in flight when training ends
+        assert row["stale_partials_applied"] == (1 if r > 0 else 0)
+        assert row["dropped_clients"] == 1  # client 3, nothing else
+        want_fresh = 3  # wave 0's sampled survivors (client 3 dead)
+        want = want_fresh + (4 if r > 0 else 0)
+        assert row["participants"] == want
+        assert "skipped" not in row

@@ -472,3 +472,166 @@ def test_client_dropout_mid_run_continues(tmp_path):
     assert float(stats.total_weight) == pytest.approx(3 * 8)
     assert float(stats.num_participants) == 4  # sampled, but one is empty
     assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(params))
+
+
+# --- checkpoint integrity: sha256 sidecar + last-good fallback (r13) --------
+
+
+def test_checkpoint_sha_sidecar_written_and_verified(tmp_path):
+    from qfedx_tpu.run.checkpoint import CheckpointIntegrityError
+
+    ck = Checkpointer(tmp_path, every=1, keep=3)
+    ck.save(2, small_params())
+    sha_path = tmp_path / "ckpt_000002.sha256"
+    assert sha_path.exists()
+    ck.verify(2)  # clean checkpoint passes
+    # flip bytes INSIDE the npz (not a truncation — the parse might
+    # even survive it; the sha must not)
+    npz = tmp_path / "ckpt_000002.npz"
+    raw = bytearray(npz.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    npz.write_bytes(bytes(raw))
+    with pytest.raises(CheckpointIntegrityError, match="sha256 mismatch"):
+        ck.verify(2)
+    # explicit restore of a named round is LOUD, never silent fallback
+    with pytest.raises(CheckpointIntegrityError):
+        ck.restore(2, jax.tree.map(jnp.zeros_like, small_params()))
+
+
+def test_restore_latest_falls_back_to_last_good(tmp_path):
+    """The r13 satellite headline: a torn/corrupt newest checkpoint
+    costs one checkpoint interval, not the run — restore_latest warns,
+    skips it, and restores the previous last-good file."""
+    params = small_params()
+    ck = Checkpointer(tmp_path, every=1, keep=3)
+    ck.save(2, params)
+    newer = jax.tree.map(lambda x: x + 1.0, params)
+    ck.save(4, newer)
+    # corrupt the NEWEST checkpoint (torn write / bit rot shape)
+    npz = tmp_path / "ckpt_000004.npz"
+    npz.write_bytes(npz.read_bytes()[: npz.stat().st_size // 2])
+    template = jax.tree.map(jnp.zeros_like, params)
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        restored, rnd = Checkpointer(tmp_path, every=1).restore_latest(
+            template
+        )
+    assert rnd == 2
+    for got, want in zip(jax.tree.leaves(restored), jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+    # every checkpoint corrupt -> clean None (fresh start), not a crash
+    npz2 = tmp_path / "ckpt_000002.npz"
+    npz2.write_bytes(b"not an npz at all")
+    with pytest.warns(RuntimeWarning):
+        assert Checkpointer(tmp_path, every=1).restore_latest(template) is None
+
+
+def test_checkpoint_without_sidecar_is_legacy_ok(tmp_path):
+    """Pre-r13 checkpoints carry no sha sidecar: they restore (no sha
+    to check) and a TORN legacy file still triggers the fallback via
+    the parse-failure path."""
+    params = small_params()
+    ck = Checkpointer(tmp_path, every=1)
+    ck.save(3, params)
+    (tmp_path / "ckpt_000003.sha256").unlink()
+    restored, rnd = ck.restore_latest(jax.tree.map(jnp.zeros_like, params))
+    assert rnd == 3
+    # torn legacy file (no sidecar): unreadable npz -> skipped with a warning
+    ck.save(5, params)
+    (tmp_path / "ckpt_000005.sha256").unlink()
+    npz = tmp_path / "ckpt_000005.npz"
+    npz.write_bytes(npz.read_bytes()[:40])
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        _, rnd = ck.restore_latest(jax.tree.map(jnp.zeros_like, params))
+    assert rnd == 3
+
+
+def test_write_fault_keeps_previous_last_good(tmp_path, monkeypatch):
+    """Exercised via the existing checkpoint.write fault site: a
+    persistently failing round-4 write surfaces as the suppressed
+    async-writer error, and resume verifies + restores the round-2
+    last-good checkpoint untouched."""
+    import warnings
+
+    params = small_params()
+    ck = Checkpointer(tmp_path, every=2)
+    ck.save(2, params)
+    monkeypatch.setenv(
+        "QFEDX_FAULTS",
+        json.dumps({"seed": 1, "rules": [
+            {"site": "checkpoint.write", "rounds": [4]},
+        ]}),
+    )
+    ck.save_async(4, jax.tree.map(lambda x: x + 1.0, params))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        err = ck.wait(raise_errors=False)
+    assert err is not None
+    monkeypatch.delenv("QFEDX_FAULTS")
+    restored, rnd = ck.restore_latest(jax.tree.map(jnp.zeros_like, params))
+    assert rnd == 2
+    ck.verify(2)
+    for got, want in zip(jax.tree.leaves(restored), jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+def test_resave_crash_window_never_pairs_new_bytes_with_old_sidecar(
+    tmp_path, monkeypatch
+):
+    """Review regression (r13): re-saving an already-checkpointed round
+    (the graceful-shutdown path does) and dying between the npz rename
+    and the sidecar write must leave new-bytes + NO sidecar (legacy-
+    tolerated) — never new bytes beside the previous save's stale hash,
+    which would reject a perfectly good checkpoint on resume."""
+    import qfedx_tpu.run.checkpoint as cp
+
+    params_v1 = small_params(0)
+    params_v2 = jax.tree.map(lambda x: x + 1.0, params_v1)
+    ck = Checkpointer(tmp_path, every=1)
+    ck.save(2, params_v1)
+
+    real_replace = cp.os.replace
+
+    def die_on_sidecar(src, dst, **kw):
+        if str(dst).endswith(".sha256"):
+            raise RuntimeError("killed between renames")
+        return real_replace(src, dst, **kw)
+
+    monkeypatch.setattr(cp.os, "replace", die_on_sidecar)
+    with pytest.raises(RuntimeError, match="killed"):
+        ck.save(2, params_v2)
+    monkeypatch.undo()
+    # the stale v1 sidecar is GONE; the v2 npz verifies (legacy path)
+    assert not (tmp_path / "ckpt_000002.sha256").exists()
+    ck.verify(2)
+    restored, rnd = Checkpointer(tmp_path, every=1).restore_latest(
+        jax.tree.map(jnp.zeros_like, params_v1)
+    )
+    assert rnd == 2
+    for got, want in zip(
+        jax.tree.leaves(restored), jax.tree.leaves(params_v2)
+    ):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+def test_busy_reports_inflight_async_writes(tmp_path):
+    """The interrupt path's race guard: busy() is True while a queued
+    async write has not hit disk, False after wait() drains it."""
+    import threading
+
+    ck = Checkpointer(tmp_path, every=1)
+    assert ck.busy() is False
+    gate = threading.Event()
+    real_save = ck.save
+
+    def slow_save(r, p):
+        gate.wait(timeout=10.0)
+        return real_save(r, p)
+
+    ck.save = slow_save
+    ck.save_async(3, small_params())
+    assert ck.busy() is True  # writer blocked behind the gate
+    gate.set()
+    ck.wait()
+    assert ck.busy() is False
+    ck.save = real_save
+    assert (tmp_path / "ckpt_000003.npz").exists()

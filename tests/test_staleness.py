@@ -1,0 +1,440 @@
+"""Staleness-aware buffered aggregation (r13): parity, cancellation,
+accounting, mixed-age robustness.
+
+The r13 tentpole lets a straggler wave contribute to a LATER round at a
+staleness discount instead of dying (``QFEDX_STALE``, fed/round +
+data/stream + run/trainer). These tests pin the contracts it stands on:
+
+1. **Stale-off bit-exactness** — QFEDX_STALE off (the default) builds
+   the r12 program exactly; stale ON with zero stragglers matches it
+   bit-for-bit without secure-agg and to wave-split tolerance with it
+   (per-wave pair graphs draw DIFFERENT masks, which must still cancel
+   — the test_hier tolerance rationale).
+2. **Self-cancelling stale waves** — under QFEDX_STALE every wave's
+   ring masks pair only within the wave, so at lr=0 a SINGLE wave's
+   partial is pure mask dust on its own (< 1e-5); without the pin the
+   same partial carries unmatched cross-wave edges (the contrast that
+   proves the test can detect the difference).
+3. **ε-invariance under lateness** — the DP accountant charged the
+   ORIGIN round at sampling time; folding the already-noised partial in
+   later is post-processing, so injected delays change no ε.
+4. **Mixed-age robust combines** — trimmed_mean/median run across a
+   stack holding fresh AND stale wave partials.
+
+Shapes are tiny (3 qubits, 1 layer, 16 clients) and injected delays are
+fractions of a second: this file must stay cheap inside the tier-1
+wall-clock budget.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qfedx_tpu.data.stream import ArrayRegistry
+from qfedx_tpu.fed.config import DPConfig, FedConfig
+from qfedx_tpu.fed.round import (
+    client_mesh,
+    make_apply_partials,
+    make_fed_round_partial,
+    shard_client_data,
+    stack_partials,
+    stale_enabled,
+)
+from qfedx_tpu.fed.robust import staleness_discount
+from qfedx_tpu.models.vqc import make_vqc_classifier
+from qfedx_tpu.run.trainer import train_federated_streamed
+from qfedx_tpu.utils.faults import FaultPlan
+
+C, S, N_Q = 16, 4, 3
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    cx = rng.uniform(0, 1, (C, S, N_Q)).astype(np.float32)
+    cy = (cx.mean(axis=2) > 0.5).astype(np.int32)
+    cm = np.ones((C, S), dtype=np.float32)
+    return cx, cy, cm
+
+
+def _model():
+    return make_vqc_classifier(n_qubits=N_Q, n_layers=1, num_classes=2)
+
+
+def _test_set(n=32, seed=9):
+    rng = np.random.default_rng(seed)
+    tx = rng.uniform(0, 1, (n, N_Q)).astype(np.float32)
+    ty = (tx.mean(axis=1) > 0.5).astype(np.int32)
+    return tx, ty
+
+
+_STRAGGLER_PLAN = [
+    # Declared up front (delay ≫ deadline) so the injection is
+    # deterministic: exactly wave 1 goes late, exactly at round 1.
+    {"site": "wave.delay", "kind": "delay:0.5", "rounds": [1],
+     "waves": [1]},
+]
+
+
+def _run_streamed(cfg, stale_env, monkeypatch, plan=None, num_rounds=2,
+                  rows=None, **kw):
+    monkeypatch.setenv("QFEDX_STALE", "1" if stale_env else "0")
+    cx, cy, cm = _data(seed=7)
+    tx, ty = _test_set()
+    args = dict(
+        cohort_size=C, wave_size=4, num_rounds=num_rounds, seed=3,
+        eval_every=num_rounds + 1, mesh=client_mesh(num_devices=4),
+        fault_plan=plan,
+    )
+    args.update(kw)
+    return train_federated_streamed(
+        _model(), cfg, ArrayRegistry(cx, cy, cm), tx, ty,
+        on_round_end=(
+            None if rows is None else (lambda r, m: rows.append(m))
+        ),
+        **args,
+    )
+
+
+def test_stale_pin_parses(monkeypatch):
+    monkeypatch.delenv("QFEDX_STALE", raising=False)
+    assert stale_enabled() is False  # default OFF — the house invariant
+    monkeypatch.setenv("QFEDX_STALE", "on")
+    assert stale_enabled() is True
+    monkeypatch.setenv("QFEDX_STALE", "sometimes")
+    with pytest.raises(ValueError):
+        stale_enabled()
+
+
+def test_staleness_config_validation():
+    FedConfig(staleness_mode="poly", staleness_alpha=2.0)
+    with pytest.raises(ValueError, match="staleness_mode"):
+        FedConfig(staleness_mode="linear")
+    with pytest.raises(ValueError, match="staleness_alpha"):
+        FedConfig(staleness_mode="constant", staleness_alpha=0.0)
+    with pytest.raises(ValueError, match="staleness_alpha"):
+        FedConfig(staleness_mode="constant", staleness_alpha=1.5)
+    with pytest.raises(ValueError, match="staleness_max_age"):
+        FedConfig(staleness_max_age=0)
+
+
+def test_staleness_discount_shapes():
+    ages = np.array([0.0, 1.0, 3.0], np.float32)
+    c = np.asarray(staleness_discount("constant", 0.25, ages))
+    np.testing.assert_allclose(c, [1.0, 0.25, 0.25])
+    p = np.asarray(staleness_discount("poly", 1.0, ages))
+    np.testing.assert_allclose(p, [1.0, 0.5, 0.25])
+    # s(0) = 1 EXACTLY in both families — fresh waves cost nothing.
+    assert c[0] == 1.0 and p[0] == 1.0
+    with pytest.raises(ValueError):
+        staleness_discount("linear", 1.0, ages)
+
+
+# --- 1: the stale-off parity matrix -----------------------------------------
+
+MATRIX = [
+    # (label, secure_agg, dp, exact)
+    ("plain", False, None, True),
+    ("dp", False, "client", True),
+    ("sa", True, None, False),
+    ("sa_dp", True, "client", False),
+]
+
+
+@pytest.mark.parametrize(
+    "label,sa,dp,exact", MATRIX, ids=[m[0] for m in MATRIX]
+)
+def test_stale_on_without_stragglers_matches_off(
+    monkeypatch, label, sa, dp, exact
+):
+    """QFEDX_STALE with zero stragglers vs the default r12 program:
+    bit-exact when no masks are involved (the discount path multiplies
+    by exactly 1.0 and sums in the same order); with secure-agg the
+    per-wave pair graphs draw DIFFERENT masks, which must still cancel
+    to wave-split tolerance. QFEDX_STALE=0 itself trivially rebuilds
+    r12 (same code path) — the interesting parity is stale ON changing
+    nothing observable until a wave is actually late."""
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.1, optimizer="sgd",
+        client_fraction=0.5, secure_agg=sa, secure_agg_mode="ring",
+        dp=DPConfig(clip_norm=1.0, noise_multiplier=0.5, mode=dp)
+        if dp else None,
+    )
+    off = _run_streamed(cfg, False, monkeypatch)
+    on = _run_streamed(cfg, True, monkeypatch)
+    for a, b in zip(jax.tree.leaves(off.params), jax.tree.leaves(on.params)):
+        if exact:
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        else:
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-5, rtol=0
+            )
+    if dp:
+        assert off.epsilons == on.epsilons
+
+
+# --- 2: self-cancelling stale waves (lr=0 mask residual) --------------------
+
+
+def _single_wave_residual(monkeypatch, stale: str) -> float:
+    """Max |update_sum| of ONE wave's partial at lr=0 under ring SA —
+    the direct measure of whether the wave's masks cancel on their own."""
+    monkeypatch.setenv("QFEDX_STALE", stale)
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.0, momentum=0.0,
+        optimizer="sgd", secure_agg=True, secure_agg_mode="ring",
+    )
+    model = _model()
+    mesh = client_mesh(num_devices=4)
+    cx, cy, cm = _data(seed=1)
+    params = model.init(jax.random.PRNGKey(2))
+    pf = make_fed_round_partial(
+        model, cfg, mesh, wave_clients=4, cohort_clients=C
+    )
+    wx, wy, wm = shard_client_data(mesh, cx[4:8], cy[4:8], jnp.asarray(cm[4:8]))
+    part = pf(params, wx, wy, wm, np.int32(4), jax.random.PRNGKey(5))
+    return max(
+        float(jnp.max(jnp.abs(leaf)))
+        for leaf in jax.tree.leaves(part.update_sum)
+    )
+
+
+def test_stale_wave_partial_is_self_cancelling(monkeypatch):
+    """The property buffered staleness stands on: with QFEDX_STALE the
+    pair graph is wave-restricted, so a lone wave's lr=0 partial is
+    pure mask dust (< 1e-5) — it can land in ANY later round without
+    corruption. Without the pin the same partial carries unmatched
+    cross-wave ring edges (residual orders of magnitude larger), which
+    is also the proof this test can tell the difference."""
+    assert _single_wave_residual(monkeypatch, "1") < 1e-5
+    assert _single_wave_residual(monkeypatch, "0") > 1e-3
+
+
+def test_lr0_straggler_leaves_theta_unchanged(monkeypatch):
+    """End-to-end cancellation: lr=0 + ring SA + an injected one-round
+    straggler — after the stale partial folds in, θ still equals the
+    initial parameters to float dust (fresh waves cancel per wave, the
+    stale wave cancels on its own, and the discount scales zeros)."""
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.0, momentum=0.0,
+        optimizer="sgd", secure_agg=True, secure_agg_mode="ring",
+    )
+    rows = []
+    res = _run_streamed(
+        cfg, True, monkeypatch, plan=FaultPlan(seed=0, rules=_STRAGGLER_PLAN),
+        num_rounds=3, rows=rows, wave_deadline_s=0.1, stale_poll_s=10.0,
+    )
+    assert rows[1]["late_waves"] == 1
+    assert rows[2]["stale_partials_applied"] == 1
+    # Compare against the model's own init for THIS run's seed: the
+    # trainer derives init from seed=3 — rebuild it the same way.
+    key = jax.random.PRNGKey(3)
+    init_key, _ = jax.random.split(key)
+    init = _model().init(init_key)
+    for a, b in zip(jax.tree.leaves(init), jax.tree.leaves(res.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=0
+        )
+
+
+# --- 3: ε-invariance under lateness -----------------------------------------
+
+
+def test_epsilon_invariant_under_injected_delays(monkeypatch):
+    """The accountant charges the ORIGIN round at sampling time, so a
+    wave arriving a round late (and folding in at a discount) changes
+    no ε — pinned exactly, per round, against the clean run."""
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.1, optimizer="sgd",
+        client_fraction=0.5,
+        dp=DPConfig(clip_norm=1.0, noise_multiplier=1.0),
+    )
+    clean = _run_streamed(cfg, True, monkeypatch, num_rounds=3)
+    rows = []
+    slow = _run_streamed(
+        cfg, True, monkeypatch, plan=FaultPlan(seed=0, rules=_STRAGGLER_PLAN),
+        num_rounds=3, rows=rows, wave_deadline_s=0.1, stale_poll_s=10.0,
+    )
+    assert rows[1]["late_waves"] == 1  # the delay actually fired
+    assert rows[2]["stale_partials_applied"] == 1
+    assert clean.epsilons == slow.epsilons
+    assert len(clean.epsilons) == 3
+
+
+# --- 4: robust rules over mixed-age partials --------------------------------
+
+
+@pytest.mark.parametrize("agg", ["trimmed_mean", "median"])
+def test_robust_combine_spans_mixed_age_partials(monkeypatch, agg):
+    """trimmed_mean/median with a straggler in the stack: the round
+    completes, the stale partial joins the cross-wave combine (exact
+    ledger counts), θ stays finite, and the trimmed_fraction stat is
+    reported over the mixed-age contributors."""
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.1, optimizer="sgd",
+        aggregator=agg, trim_fraction=0.25,
+    )
+    rows = []
+    res = _run_streamed(
+        cfg, True, monkeypatch, plan=FaultPlan(seed=0, rules=_STRAGGLER_PLAN),
+        num_rounds=3, rows=rows, wave_deadline_s=0.1, stale_poll_s=10.0,
+    )
+    assert rows[1]["late_waves"] == 1
+    assert rows[1]["participants"] == 12
+    assert rows[2]["stale_partials_applied"] == 1
+    assert rows[2]["participants"] == 20  # 16 fresh + 4 stale
+    assert rows[2]["aggregator"] == agg
+    assert rows[2]["trimmed_fraction"] > 0
+    for leaf in jax.tree.leaves(res.params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_stacked_apply_discounts_ages_directly():
+    """Unit-level discount semantics: two identical partials, one
+    tagged stale — the constant-discount apply must land exactly
+    between apply(fresh only) and apply(both fresh): the stale twin
+    contributes with weight α. (Σ s·wΔ / Σ s·w over identical deltas
+    equals the common mean, so use DIFFERENT deltas per wave.)"""
+    cfg = FedConfig(staleness_mode="constant", staleness_alpha=0.5)
+    params = {"w": jnp.zeros((2,), jnp.float32)}
+
+    def part(delta, weight):
+        from qfedx_tpu.fed.round import RoundPartial
+
+        return RoundPartial(
+            update_sum={"w": jnp.asarray(delta, jnp.float32) * weight},
+            weight_sum=jnp.float32(weight),
+            loss_sum=jnp.float32(0.0),
+            num_participants=jnp.float32(weight),
+        )
+
+    fresh = part([1.0, 0.0], 4.0)
+    stale = part([0.0, 2.0], 4.0)
+    apply_fn = make_apply_partials(cfg, cohort_clients=0)
+    p_new, stats = apply_fn(
+        params, stack_partials([fresh, stale]),
+        ages=np.array([0.0, 1.0], np.float32),
+    )
+    # θ = (1·4·[1,0] + 0.5·4·[0,2]) / (4 + 2) = [2/3, 2/3]
+    np.testing.assert_allclose(
+        np.asarray(p_new["w"]), [2.0 / 3.0, 2.0 / 3.0], atol=1e-6
+    )
+    # counts stay undiscounted — stale clients genuinely participated
+    assert float(stats.num_participants) == 8.0
+    # ages=None is the r12 apply exactly: plain sum, no discount
+    p_plain, _ = apply_fn(params, stack_partials([fresh, stale]))
+    np.testing.assert_allclose(np.asarray(p_plain["w"]), [0.5, 1.0], atol=1e-6)
+    # poly mode: s(1) = (1+1)^-1 = 0.5 — same result by construction
+    cfg_p = FedConfig(staleness_mode="poly", staleness_alpha=1.0)
+    p_poly, _ = make_apply_partials(cfg_p, 0)(
+        params, stack_partials([fresh, stale]),
+        ages=np.array([0.0, 1.0], np.float32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(p_poly["w"]), np.asarray(p_new["w"]), atol=1e-7
+    )
+
+
+# --- lifecycle: recovery, bounded buffer, guard rails -----------------------
+
+
+def test_straggler_clients_are_recovered_not_dropped(monkeypatch):
+    """The tentpole's point: with buffering ON a one-round straggler
+    costs zero clients — every sampled client's work lands (one round
+    of it discounted); with the r12 drop path the same injection loses
+    the wave outright. Ledger counts pinned exactly."""
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1)
+    plan_rules = [
+        {"site": "client.slow", "kind": "slow:0.5", "clients": [5]},
+    ]
+    rows_buf = []
+    _run_streamed(
+        cfg, True, monkeypatch, plan=FaultPlan(seed=0, rules=plan_rules),
+        num_rounds=3, rows=rows_buf, wave_deadline_s=0.1, stale_poll_s=10.0,
+    )
+    # client 5 lives in wave 1 (ids 4..7): its wave goes late EVERY
+    # round; each next round salvages it. No dropouts anywhere.
+    for r, row in enumerate(rows_buf):
+        assert row["late_waves"] == 1
+        assert row["dropped_clients"] == 0
+        assert row["stale_partials_applied"] == (1 if r > 0 else 0)
+    # drop mode (stale off): a straggler is pure casualties. The LAST
+    # wave is delayed (no trailing waves — drop mode has no up-front
+    # declaration, so a mid-round straggler head-of-line-blocks the
+    # in-order uploader and later waves would time out too).
+    rows_drop = []
+    _run_streamed(
+        cfg, False, monkeypatch,
+        plan=FaultPlan(seed=0, rules=[
+            {"site": "wave.delay", "kind": "delay:0.6", "waves": [3]},
+        ]),
+        num_rounds=2, rows=rows_drop, wave_deadline_s=0.1,
+    )
+    assert rows_drop[0]["dropped_clients"] == 4
+    assert rows_drop[0]["dropped_waves"] == 1
+
+
+def test_dead_straggler_degrades_to_dropouts(monkeypatch):
+    """A wave that goes late AND then fails its deferred upload for
+    good (persistent registry fault) degrades to casualties at the
+    round that discovers it — counted once, exactly, with the SAME
+    convention as the fresh dead-wave path: every SAMPLED client of
+    the never-dispatched wave counts, including one the plan had
+    already marked dropped (no in-program counter ever saw it, and
+    'drop' vs 'buffer' must reconcile to identical totals)."""
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1)
+    plan = FaultPlan(seed=0, rules=[
+        {"site": "wave.delay", "kind": "delay:0.3", "rounds": [0],
+         "waves": [1]},
+        {"site": "registry.fetch", "rounds": [0], "waves": [1]},
+        # a plan-dropped client INSIDE the dead straggler wave — still
+        # exactly one of the wave's 4 casualties, never uncounted
+        {"site": "client.compute", "kind": "drop", "clients": [5],
+         "rounds": [0]},
+    ])
+    rows = []
+    _run_streamed(
+        cfg, True, monkeypatch, plan=plan, num_rounds=2, rows=rows,
+        wave_deadline_s=0.1, stale_poll_s=10.0,
+    )
+    assert rows[0]["late_waves"] == 1
+    assert rows[0]["dropped_clients"] == 0  # not yet known dead
+    assert rows[1]["stale_partials_applied"] == 0
+    assert rows[1]["dropped_clients"] == 4  # the whole sampled wave
+    assert rows[1]["stale_discarded_waves"] == 1
+
+
+def test_overage_straggler_is_abandoned(monkeypatch):
+    """The BOUNDED buffer: a straggler still unresolved after
+    staleness_max_age rounds is abandoned — its clients counted as
+    dropouts — instead of pinning host state forever."""
+    cfg = FedConfig(
+        local_epochs=1, batch_size=4, learning_rate=0.1,
+        staleness_max_age=1,
+    )
+    plan = FaultPlan(seed=0, rules=[
+        {"site": "wave.delay", "kind": "delay:2.0", "rounds": [0],
+         "waves": [1]},
+    ])
+    rows = []
+    _run_streamed(
+        cfg, True, monkeypatch, plan=plan, num_rounds=2, rows=rows,
+        wave_deadline_s=0.1, stale_poll_s=0.2,
+    )
+    assert rows[0]["late_waves"] == 1
+    assert rows[1]["stale_partials_applied"] == 0
+    assert rows[1]["stale_discarded_waves"] == 1
+    assert rows[1]["dropped_clients"] == 4
+
+
+def test_stale_requires_hier_and_guards(monkeypatch):
+    cfg = FedConfig(local_epochs=1, batch_size=4)
+    monkeypatch.setenv("QFEDX_HIER", "off")
+    # wave == cohort so the hier-off multi-wave guard stays silent and
+    # the STALENESS requirement is what fires
+    with pytest.raises(ValueError, match="QFEDX_STALE"):
+        _run_streamed(cfg, True, monkeypatch, wave_size=C)
+    monkeypatch.delenv("QFEDX_HIER")
+    monkeypatch.setenv("QFEDX_GUARDS", "off")
+    with pytest.raises(ValueError, match="QFEDX_GUARDS"):
+        _run_streamed(cfg, True, monkeypatch)

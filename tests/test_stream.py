@@ -604,3 +604,253 @@ def test_h2d_overlaps_dispatch_in_trace(monkeypatch):
     assert inside, "no ingest.h2d span started inside round.dispatch"
     # queue depth gauge was exercised
     assert "ingest.queue_depth" in obs.registry().gauges
+
+
+# --- buffer mode: straggler salvage (r13) -----------------------------------
+
+
+def test_buffer_mode_declared_straggler_salvaged():
+    """Deterministic injection path: a plan-delayed wave (delay >
+    deadline) yields a LateWave marker immediately — no head-of-line
+    blocking of the other waves — and poll_late hands the finished
+    upload over with the right bytes, exactly once."""
+    from qfedx_tpu.data.stream import LateWave
+    from qfedx_tpu.utils.faults import FaultPlan
+
+    cx, cy, cm = _data(C=16)
+    reg = ArrayRegistry(cx, cy, cm)
+    mesh = client_mesh(num_devices=4)
+    plan = FaultPlan(seed=0, rules=[
+        {"site": "wave.delay", "kind": "delay:0.4", "waves": [1]},
+    ])
+    stream = WaveStream(reg, mesh, np.arange(16), wave_size=4, depth=1,
+                        fault_plan=plan, round_idx=0,
+                        on_wave_error="buffer", wave_deadline_s=0.1)
+    got = list(stream)
+    assert len(got) == 4
+    late = [g for g in got if isinstance(g, LateWave)]
+    served = [g for g in got if not isinstance(g, LateWave)]
+    assert [lw.wave for lw in late] == [1] and late[0].wave_base == 4
+    assert sorted(g[0] for g in served) == [0, 8, 12]  # others prompt
+    assert stream.late_pending()
+    items, failed = stream.poll_late(timeout_s=10.0)
+    assert failed == [] and len(items) == 1
+    wave_base, (wx, _wy, _wm) = items[0]
+    assert wave_base == 4
+    np.testing.assert_array_equal(np.asarray(wx), cx[4:8])
+    assert not stream.late_pending()
+    # exactly once: a second poll returns nothing
+    assert stream.poll_late() == ([], [])
+    stream.close()
+
+
+def test_buffer_mode_genuine_hang_salvaged_via_deadline():
+    """Unplanned-slowness path: a registry fetch that HANGS past the
+    consumer deadline converts into a LateWave (instead of r12's
+    DroppedWave) and the unstuck upload is banked for poll_late — the
+    straggler's work survives without any fault plan."""
+    import time
+
+    from qfedx_tpu.data.stream import LateWave
+
+    cx, cy, cm = _data(C=16)
+
+    class Hanging:
+        num_clients = 16
+
+        def batch(self, ids):
+            if ids[0] == 4:
+                time.sleep(0.8)
+            return cx[ids], cy[ids], cm[ids]
+
+    mesh = client_mesh(num_devices=4)
+    stream = WaveStream(Hanging(), mesh, np.arange(16), wave_size=4,
+                        depth=1, on_wave_error="buffer",
+                        wave_deadline_s=0.25)
+    got = list(stream)
+    late = [g for g in got if isinstance(g, LateWave)]
+    assert [lw.wave for lw in late] == [1]
+    items, failed = stream.poll_late(timeout_s=10.0)
+    assert failed == [] and [it[0] for it in items] == [4]
+    np.testing.assert_array_equal(np.asarray(items[0][1][0]), cx[4:8])
+    stream.close()
+
+
+def test_buffer_mode_failed_wave_still_drops():
+    """A wave that FAILS (retry exhausted) is a casualty even in buffer
+    mode — there is nothing to finish in the background; and a
+    straggler whose deferred upload then fails surfaces through
+    poll_late's failed list, not as a silent hang."""
+    from qfedx_tpu.utils.faults import FaultPlan
+
+    reg = ArrayRegistry(*_data(C=16))
+    mesh = client_mesh(num_devices=4)
+    plan = FaultPlan(seed=0, rules=[
+        {"site": "registry.fetch", "waves": [2]},  # persistent failure
+    ])
+    stream = WaveStream(reg, mesh, np.arange(16), wave_size=4, depth=1,
+                        fault_plan=plan, round_idx=0,
+                        on_wave_error="buffer", wave_deadline_s=5.0)
+    got = list(stream)
+    stream.close()
+    dropped = [g for g in got if isinstance(g, DroppedWave)]
+    assert [d.wave for d in dropped] == [2]
+    # straggler + persistent failure => failed via poll_late
+    plan2 = FaultPlan(seed=0, rules=[
+        {"site": "wave.delay", "kind": "delay:0.3", "waves": [1]},
+        {"site": "registry.fetch", "waves": [1]},
+    ])
+    stream2 = WaveStream(reg, mesh, np.arange(16), wave_size=4, depth=1,
+                         fault_plan=plan2, round_idx=0,
+                         on_wave_error="buffer", wave_deadline_s=0.1)
+    list(stream2)
+    items, failed = stream2.poll_late(timeout_s=15.0)
+    assert items == [] and failed == [1]
+    assert not stream2.late_pending()
+    stream2.close()
+
+
+# --- graceful shutdown (r13 satellite) --------------------------------------
+
+
+def _shutdown_run(tmp_path, interrupt_round, num_rounds=4, kill=None):
+    from qfedx_tpu.run.checkpoint import Checkpointer
+
+    cx, cy, cm = _data(seed=4)
+    tx, ty = _test_set()
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1)
+    reg = ArrayRegistry(cx, cy, cm)
+    mesh = client_mesh(num_devices=4)
+    kw = dict(cohort_size=16, wave_size=4, seed=11, eval_every=9, mesh=mesh)
+
+    def hook(r, m):
+        if interrupt_round is not None and r == interrupt_round:
+            if kill is not None:
+                kill()
+            else:
+                raise KeyboardInterrupt
+    ck = Checkpointer(tmp_path / "ck", every=100)  # cadence never fires
+    return train_federated_streamed(
+        _model(), cfg, reg, tx, ty, num_rounds=num_rounds,
+        checkpointer=ck, on_round_end=hook, **kw,
+    )
+
+
+def test_kill_the_consumer_drains_and_checkpoints(tmp_path):
+    """Graceful shutdown: a KeyboardInterrupt mid-run (the Ctrl-C /
+    orchestrator-kill shape) drains the wave uploader and async
+    checkpoint writer, writes ONE final synchronous checkpoint, leaves
+    no ingest thread behind, and a resumed run replays to the exact
+    bytes of an uninterrupted one."""
+    import threading
+
+    import jax as _jax
+
+    straight = _shutdown_run(tmp_path / "a", interrupt_round=None)
+    with pytest.raises(KeyboardInterrupt):
+        _shutdown_run(tmp_path / "b", interrupt_round=1)
+    # no leaked uploader thread (the no-daemon-hang pin)
+    assert not any(
+        t.name == "qfedx-ingest" and t.is_alive()
+        for t in threading.enumerate()
+    )
+    # the final synchronous checkpoint exists at the last COMPLETED round
+    from qfedx_tpu.run.checkpoint import Checkpointer
+
+    ck = Checkpointer(tmp_path / "b" / "ck", every=100)
+    assert ck.latest_round() == 1
+    ck.verify(1)
+    resumed = _shutdown_run(tmp_path / "b", interrupt_round=None)
+    for a, b in zip(
+        _jax.tree.leaves(straight.params), _jax.tree.leaves(resumed.params)
+    ):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sigterm_translates_to_graceful_interrupt(tmp_path):
+    """An orchestrator's SIGTERM lands as KeyboardInterrupt("SIGTERM")
+    and takes the same drain + final-checkpoint path."""
+    import os
+    import signal as signal_mod
+
+    def kill():
+        os.kill(os.getpid(), signal_mod.SIGTERM)
+
+    with pytest.raises(KeyboardInterrupt, match="SIGTERM"):
+        _shutdown_run(tmp_path, interrupt_round=1, kill=kill)
+    from qfedx_tpu.run.checkpoint import Checkpointer
+
+    assert Checkpointer(tmp_path / "ck", every=100).latest_round() == 1
+
+
+def test_stale_late_marker_never_shifts_cohort_slots():
+    """Review regression (r13): a genuinely-slow wave ahead of a
+    plan-DECLARED straggler means the consumer's own deadline covers
+    the declared wave before the uploader's queued LateWave marker
+    arrives — the stale marker must be discarded (never re-yielded into
+    a later wave's cohort slot, which would double-count the straggler
+    and silently lose the final wave) and both stragglers' uploads must
+    still salvage. Second shape: a declared marker left UNCONSUMED on
+    the queue when iteration ends must not crash poll_late."""
+    import time
+
+    from qfedx_tpu.data.stream import LateWave
+    from qfedx_tpu.utils.faults import FaultPlan
+
+    cx, cy, cm = _data(C=16)
+
+    class SlowWave0:
+        num_clients = 16
+
+        def batch(self, ids):
+            if ids[0] == 0:
+                time.sleep(0.5)  # genuine slowness, NOT plan-declared
+            return cx[ids], cy[ids], cm[ids]
+
+    mesh = client_mesh(num_devices=4)
+    plan = FaultPlan(seed=0, rules=[
+        {"site": "wave.delay", "kind": "delay:1.0", "waves": [1]},
+    ])
+    stream = WaveStream(SlowWave0(), mesh, np.arange(16), wave_size=4,
+                        depth=1, fault_plan=plan, round_idx=0,
+                        on_wave_error="buffer", wave_deadline_s=0.1)
+    got = list(stream)
+    assert len(got) == 4
+    late = [g for g in got if isinstance(g, LateWave)]
+    served = [g for g in got if not isinstance(g, LateWave)]
+    # waves 0 (deadline) and 1 (declared) late EXACTLY ONCE each; waves
+    # 2 and 3 served exactly once — no slot shift, no lost final wave
+    assert sorted(lw.wave for lw in late) == [0, 1]
+    assert sorted(g[0] for g in served) == [8, 12]
+    items, failed = stream.poll_late(timeout_s=15.0)
+    assert failed == [] and sorted(it[0] for it in items) == [0, 4]
+    for lo, (wx, _wy, _wm) in items:
+        np.testing.assert_array_equal(np.asarray(wx), cx[lo:lo + 4])
+    stream.close()
+
+    # shape 2: LAST wave declared late behind a genuinely slow wave —
+    # its marker may still sit on the queue when iteration ends;
+    # poll_late must classify it, not crash, and still salvage both.
+    class SlowWave2:
+        num_clients = 16
+
+        def batch(self, ids):
+            if ids[0] == 8:
+                time.sleep(0.5)
+            return cx[ids], cy[ids], cm[ids]
+
+    plan2 = FaultPlan(seed=0, rules=[
+        {"site": "wave.delay", "kind": "delay:1.0", "waves": [3]},
+    ])
+    stream2 = WaveStream(SlowWave2(), mesh, np.arange(16), wave_size=4,
+                         depth=1, fault_plan=plan2, round_idx=0,
+                         on_wave_error="buffer", wave_deadline_s=0.1)
+    got2 = list(stream2)
+    assert len(got2) == 4
+    items2, failed2 = stream2.poll_late(timeout_s=15.0)
+    assert failed2 == []
+    banked = sorted(it[0] for it in items2)
+    fresh = sorted(g[0] for g in got2 if not isinstance(g, LateWave))
+    # every wave exactly once across fresh + salvaged, none doubled
+    assert sorted(banked + fresh) == [0, 4, 8, 12]
+    stream2.close()
