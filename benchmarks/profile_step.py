@@ -21,6 +21,7 @@ import gzip
 import json
 import os
 import sys
+import time
 from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -169,6 +170,56 @@ def run_one(tag, trace_dir, args):
     return t, by_op
 
 
+def run_serve_profile(args):
+    """Profile the SERVED forward (r14): per-bucket warmup compile wall,
+    steady-state per-batch/per-request latency of the persistent
+    compiled forward, and its lowered op census — the serving half of
+    the PERF.md §15 floor methodology. The forward goes through the
+    SAME persistent-forward cache production serving uses
+    (serve/forward.py), so what is measured is what serves."""
+    import jax
+    import numpy as np
+
+    from benchmarks._util import retry_timing
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+    from qfedx_tpu.obs.hlo import module_counts
+    from qfedx_tpu.serve.forward import persistent_forward
+
+    model = make_vqc_classifier(
+        n_qubits=args.n, n_layers=args.layers, num_classes=2,
+        remat=args.remat,
+    )
+    params = model.init(jax.random.PRNGKey(0))
+    fwd = persistent_forward(model.apply)
+    rng = np.random.default_rng(0)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    compiled = jax.default_backend() == "tpu"
+    reps = 16
+    for b in buckets:
+        x = rng.uniform(0, 1, (b, args.n)).astype(np.float32)
+        t0 = time.perf_counter()
+        np.asarray(fwd(params, x))  # warmup: compile this bucket
+        warm_s = time.perf_counter() - t0
+
+        def measure():
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(reps):
+                out = fwd(params, x)
+            np.asarray(out)  # ONE fetch anchors true completion (§6)
+            return (time.perf_counter() - t0) / reps
+
+        t = retry_timing(measure, floor=1e-6, label=f"serve b={b}")
+        print(f"[serve] bucket {b:4d}: warmup {warm_s*1e3:8.1f} ms, "
+              f"batch {t*1e3:8.3f} ms, per-request {t/b*1e6:8.1f} us")
+    xm = rng.uniform(0, 1, (buckets[-1], args.n)).astype(np.float32)
+    counts = module_counts(
+        jax.jit(lambda p: model.apply(p, xm)), params, args.n,
+        compiled=compiled,
+    )
+    print("[serve:hlo] " + " ".join(f"{k}={v}" for k, v in counts.items()))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--trace-dir", default="/tmp/qfedx-prof")
@@ -180,6 +231,14 @@ def main():
                     help="per-layer jax.checkpoint (the retired r04 n=20 "
                     "config — reproduces the cliff of docs/PERF.md §7; "
                     "the shipped bench runs n=20 without remat)")
+    ap.add_argument("--serve", action="store_true",
+                    help="profile the SERVED forward instead of the "
+                    "training step: per-bucket warmup compile wall + "
+                    "steady-state batch latency + lowered op census "
+                    "through the production persistent-forward cache "
+                    "(PERF.md §15; docs/SERVING.md)")
+    ap.add_argument("--buckets", default="1,8,32",
+                    help="--serve: comma-separated bucket batch shapes")
     ap.add_argument("--hlo-only", action="store_true",
                     help="skip timing/tracing; report lowered + compiled "
                     "op counts with the fusion pass on vs off (the r07 "
@@ -193,6 +252,9 @@ def main():
     enable_cache(jax)
     print(f"devices: {jax.devices()}")
 
+    if args.serve:
+        run_serve_profile(args)
+        return
     if args.hlo_only:
         run_hlo_counts(args)
         return

@@ -8,7 +8,6 @@ jitted program over padded batches (static shapes), gradients never built.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -35,9 +34,16 @@ def make_evaluator(model: Model, batch_size: int = 256, apply_fn=None,
         )
     fwd = apply_fn if apply_fn is not None else model.apply
 
-    @jax.jit
-    def batch_logits(params, xb):
-        return fwd(params, xb)
+    # The shared persistent-forward cache (serve/forward.py, r14): every
+    # evaluator built for the same model — the trainer's capped + full
+    # pair, the serving engine's buckets — shares ONE jitted wrapper per
+    # (model, engine route), so the serve warmup's no-compile guarantee
+    # provably covers evaluator traffic and a route-pin flip can never
+    # be served a stale program (docs/PERF.md §15d has the honest
+    # boundary of the wall-clock claim).
+    from qfedx_tpu.serve.forward import persistent_forward
+
+    batch_logits = persistent_forward(fwd)
 
     def evaluate(params, x, y):
         x = np.asarray(x, dtype=np.float32)

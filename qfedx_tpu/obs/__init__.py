@@ -20,6 +20,7 @@ Everything is a no-op unless ``QFEDX_TRACE=1`` (see trace.enabled).
 
 from qfedx_tpu.obs.export import (
     chrome_trace_events,
+    percentile,
     phase_rollup,
     phase_totals,
     snapshot,
@@ -46,6 +47,7 @@ __all__ = [
     "enabled",
     "gauge",
     "module_counts",
+    "percentile",
     "phase_rollup",
     "phase_totals",
     "record_device_memory",

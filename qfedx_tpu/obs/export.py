@@ -22,8 +22,11 @@ from pathlib import Path
 from qfedx_tpu.obs.trace import Span, registry
 
 
-def _percentile(sorted_vals: list[float], q: float) -> float:
-    """Nearest-rank percentile of an already-sorted list."""
+def percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list — the ONE
+    quantile definition (phase rollups, the serve CLI summary and the
+    bench serving rows all report through this, so their p50/p95 can
+    never drift apart on index math)."""
     if not sorted_vals:
         return 0.0
     idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
@@ -44,8 +47,8 @@ def phase_rollup(spans: list[Span] | None = None) -> dict[str, dict]:
         rows[name] = {
             "count": len(group),
             "total_s": round(sum(durs), 6),
-            "p50_s": round(_percentile(durs, 0.50), 6),
-            "p95_s": round(_percentile(durs, 0.95), 6),
+            "p50_s": round(percentile(durs, 0.50), 6),
+            "p95_s": round(percentile(durs, 0.95), 6),
         }
         compile_s = sum(sp.compile_s for sp in group)
         if compile_s > 0:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import json
+from pathlib import Path
 
 from qfedx_tpu.fed.config import DPConfig, FedConfig
 from qfedx_tpu.run.config import (
@@ -150,6 +151,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "gets a phase_breakdown rollup, and a Perfetto/"
                         "chrome://tracing-loadable trace.json lands in the "
                         "run dir (docs/OBSERVABILITY.md)")
+
+    v = sub.add_parser(
+        "serve",
+        help="low-latency batched inference from a trained run's "
+             "checkpoint (docs/SERVING.md)",
+    )
+    v.add_argument("--run-dir", required=True,
+                   help="a tracked run directory (config.json + checkpoints/)")
+    v.add_argument("--round", type=int, default=None,
+                   help="restore this checkpointed round (default: newest "
+                        "last-good checkpoint)")
+    v.add_argument("--buckets", default=None,
+                   help="comma-separated ascending batch buckets compiled "
+                        "at warmup (default QFEDX_SERVE_BUCKETS, then 1,8,32)")
+    v.add_argument("--deadline-ms", type=float, default=None,
+                   help="micro-batcher latency budget: max ms a request "
+                        "waits for its bucket to fill (default "
+                        "QFEDX_SERVE_DEADLINE_MS, then 5)")
+    v.add_argument("--max-queue", type=int, default=None,
+                   help="bounded admission queue depth; past it requests "
+                        "are shed (default QFEDX_SERVE_QUEUE, then 256)")
+    v.add_argument("--input", default="-",
+                   help="JSONL request stream ('-' = stdin): one "
+                        '{"features": [...]} (or a bare array) per line')
+    v.add_argument("--output", default="-",
+                   help="JSONL response stream ('-' = stdout), in input order")
+    v.add_argument("--trace", action="store_true",
+                   help="record serve.* spans and write trace.json next to "
+                        "the run dir's artifacts (docs/OBSERVABILITY.md)")
 
     d = sub.add_parser("demo", help="encoder walkthrough (reference testEncoder parity)")
     d.add_argument("--dataset", default="mnist",
@@ -344,6 +374,163 @@ def run_train(
         return summary
 
 
+def run_serve(args) -> dict:
+    """``qfedx serve``: restore → warm every bucket → answer a JSONL
+    request stream through the micro-batcher, draining on SIGTERM/EOF.
+
+    Responses are written in input order: one
+    ``{"id", "pred", "probs", "logits"}`` object per admitted request,
+    ``{"id", "error", "code": 400}`` for per-request rejections (the
+    malformed/NaN path — the stream keeps flowing). The in-flight window
+    is capped at the admission queue depth, so a slow device
+    backpressures the reader instead of ballooning futures.
+    """
+    import contextlib
+    import os
+    import sys
+
+    from qfedx_tpu import obs
+    from qfedx_tpu.serve import (
+        MicroBatcher,
+        RequestError,
+        ServeConfig,
+        engine_from_run_dir,
+    )
+    from qfedx_tpu.utils.host import is_primary
+
+    if args.trace:
+        os.environ["QFEDX_TRACE"] = "1"
+        obs.reset()
+    say = print if is_primary() else (lambda *a, **k: None)
+
+    buckets = (
+        tuple(int(b) for b in args.buckets.split(",")) if args.buckets
+        else None
+    )
+    cfg = ServeConfig.resolve(
+        buckets=buckets, deadline_ms=args.deadline_ms,
+        max_queue=args.max_queue,
+    )
+    engine, info = engine_from_run_dir(
+        args.run_dir, round_idx=args.round, config=cfg
+    )
+    say(f"[qfedx_tpu] serving {info['model']} from {info['run_dir']} "
+        f"(round {info['round']}, {info['num_classes']} classes)")
+    with obs.span("serve.warmup_all"):
+        warm = engine.warmup()
+    say(f"[qfedx_tpu] warm buckets: " + ", ".join(
+        f"{b} ({v['wall_s']:.2f}s wall, {v['compile_s']:.2f}s compile)"
+        for b, v in warm["buckets"].items()
+    ))
+
+    in_f = sys.stdin if args.input == "-" else open(args.input)
+    out_f = sys.stdout if args.output == "-" else open(args.output, "w")
+    latencies: list[float] = []
+    window: list = []  # ordered (id, future | error-dict) in-flight pairs
+
+    def emit(rid, fut_or_err):
+        if isinstance(fut_or_err, dict):
+            rec = {"id": rid, **fut_or_err}
+        else:
+            try:
+                res = fut_or_err.result(timeout=60.0)
+            except Exception as exc:  # noqa: BLE001 — a failed batch answers
+                # its own requests with 5xx records; the server keeps serving
+                rec = {"id": rid, "error": str(exc), "code": 500}
+            else:
+                # done_t - submit_t is the true submit→answer latency
+                # (the batcher's clock stamps both); emit can run long
+                # after completion when the input stream is slow, so
+                # measuring here would fold reader idle time into p50.
+                latencies.append(fut_or_err.done_t - fut_or_err.submit_t)
+                rec = {
+                    "id": rid,
+                    "pred": res["pred"],
+                    "probs": [round(float(p), 6) for p in res["probs"]],
+                    "logits": [float(v) for v in res["logits"]],
+                }
+        out_f.write(json.dumps(rec) + "\n")
+        out_f.flush()
+
+    # SIGTERM lands as KeyboardInterrupt on the main thread (the same
+    # hardened translation the streamed trainer uses — utils/host): the
+    # finally-drain answers every admitted request before exit.
+    from qfedx_tpu.utils.host import install_sigterm_interrupt, restore_sigterm
+
+    sigterm_token = install_sigterm_interrupt()
+    batcher = MicroBatcher(engine).start()
+    responses = 0
+    try:
+        for i, line in enumerate(in_f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+            except json.JSONDecodeError as exc:
+                window.append((i, {"error": f"bad JSON: {exc}", "code": 400}))
+                continue
+            feats = req.get("features") if isinstance(req, dict) else req
+            rid = req.get("id", i) if isinstance(req, dict) else i
+            try:
+                fut = batcher.submit(feats)
+            except RequestError as exc:
+                window.append((rid, {"error": str(exc), "code": 400}))
+            else:
+                window.append((rid, fut))
+            # Admission-depth window: resolve the head once the window
+            # is full, so submit can never hit its own Overloaded shed.
+            # Emit-then-pop (here and below): an interrupt mid-flush
+            # leaves only UNANSWERED entries in the window for the
+            # finally-drain — at-least-once delivery, never a dropped
+            # response.
+            while len(window) >= cfg.max_queue:
+                emit(*window[0])
+                window.pop(0)
+                responses += 1
+        while window:
+            emit(*window[0])
+            window.pop(0)
+            responses += 1
+    except KeyboardInterrupt:
+        say("[qfedx_tpu] interrupted — draining in-flight requests")
+    finally:
+        batcher.close(drain=True)
+        while window:  # answered by the drain; emit in order
+            pair = window.pop(0)
+            with contextlib.suppress(Exception):
+                emit(*pair)
+                responses += 1
+        restore_sigterm(sigterm_token)
+        if in_f is not sys.stdin:
+            in_f.close()
+        if out_f is not sys.stdout:
+            out_f.close()
+    lat = sorted(latencies)
+
+    def pct(q):  # the shared quantile definition (bench rows use it too)
+        return round(1e3 * obs.percentile(lat, q), 3)
+
+    # "served" counts requests the ENGINE answered (batcher ledger);
+    # "responses" counts emitted JSONL lines, which include per-request
+    # 400/500 error records — served + rejected must reconcile, not
+    # double-count.
+    summary = {
+        "served": batcher.stats["served"],
+        "responses": responses,
+        "p50_ms": pct(0.50) if lat else None,
+        "p95_ms": pct(0.95) if lat else None,
+        **{k: batcher.stats[k] for k in ("rejected", "shed", "batches")},
+    }
+    say("[qfedx_tpu] serve summary: " + json.dumps(summary))
+    if obs.enabled() and is_primary():
+        trace_path = obs.write_chrome_trace(
+            Path(args.run_dir) / "serve_trace.json"
+        )
+        say(f"[qfedx_tpu] serve trace: {trace_path}")
+    return summary
+
+
 def jax_profiler_trace(log_dir):
     """jax.profiler.trace context (TensorBoard-loadable trace of the rounds
     — the wall-clock observability the reference roadmap wants tracked,
@@ -375,6 +562,8 @@ def main(argv=None):
         cfg = config_from_args(args)
         run_train(cfg, resume=args.resume, plots=args.plots,
                   profile=args.profile, trace=args.trace)
+    elif args.cmd == "serve":
+        run_serve(args)
     elif args.cmd == "demo":
         from qfedx_tpu.run.demo import run_demo
 

@@ -108,6 +108,54 @@ class ExperimentConfig:
         return f"{tag}-{self.data.dataset}-c{self.data.num_clients}-{self.fed.algorithm}"
 
 
+def _fields_of(cls) -> set[str]:
+    import dataclasses
+
+    return {f.name for f in dataclasses.fields(cls)}
+
+
+def _known(cls, d: dict) -> dict:
+    """Restrict a config.json sub-dict to ``cls``'s current fields —
+    forward compatibility: a run dir written by a NEWER version (extra
+    fields) still restores on this one; unknown keys are dropped with a
+    warning rather than crashing the restore (the dataclass defaults
+    cover the other direction, an OLDER run dir missing new fields)."""
+    unknown = sorted(set(d) - _fields_of(cls))
+    if unknown:
+        import warnings
+
+        warnings.warn(
+            f"config.json: ignoring unknown {cls.__name__} fields "
+            f"{unknown} (written by a newer version?)",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+    return {k: v for k, v in d.items() if k in _fields_of(cls)}
+
+
+def experiment_config_from_dict(d: dict) -> ExperimentConfig:
+    """Rebuild an ExperimentConfig from a run dir's ``config.json``
+    (written by run.metrics.ExperimentRun) — the restore half of the
+    "every run is reproducible from one JSON blob" contract (module
+    docstring), used by ``qfedx serve`` to reconstruct the trained
+    model around a checkpoint (serve/engine.engine_from_run_dir)."""
+    d = dict(d)
+    data_d = _known(DataConfig, dict(d.pop("data", {})))
+    if data_d.get("classes") is not None:
+        data_d["classes"] = tuple(int(c) for c in data_d["classes"])
+    model_d = _known(ModelConfig, dict(d.pop("model", {})))
+    fed_d = _known(FedConfig, dict(d.pop("fed", {})))
+    dp_d = fed_d.pop("dp", None)
+    dp = DPConfig(**_known(DPConfig, dict(dp_d))) if dp_d else None
+    top = _known(ExperimentConfig, d)
+    return ExperimentConfig(
+        data=DataConfig(**data_d),
+        model=ModelConfig(**model_d),
+        fed=FedConfig(dp=dp, **fed_d),
+        **top,
+    )
+
+
 def build_model(cfg: ExperimentConfig, num_classes: int):
     """ModelConfig → Model (with noise bundle when any noise is on)."""
     m = cfg.model

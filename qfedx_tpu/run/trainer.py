@@ -12,8 +12,6 @@ checkpoint-every-K-rounds with resume (ROADMAP.md:90-91), and JSONL metrics
 
 from __future__ import annotations
 
-import signal
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -38,6 +36,7 @@ from qfedx_tpu.fed.round import (
 )
 from qfedx_tpu.models.api import Model
 from qfedx_tpu.utils import faults, pins, trees
+from qfedx_tpu.utils.host import install_sigterm_interrupt, restore_sigterm
 
 
 @dataclass
@@ -985,18 +984,9 @@ def train_federated_streamed(
     # are drained, ONE final synchronous checkpoint lands at the last
     # completed round, and the interrupt still propagates — no
     # daemon-thread hang, no torn metrics.jsonl row (the logger fsyncs
-    # whole lines), no silently-lost progress.
-    prev_sigterm = None
-    in_main = threading.current_thread() is threading.main_thread()
-    if in_main:
-
-        def _on_sigterm(signum, frame):
-            raise KeyboardInterrupt("SIGTERM")
-
-        try:
-            prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
-        except (ValueError, OSError):  # exotic embeddings; run unguarded
-            in_main = False
+    # whole lines), no silently-lost progress. The install/restore pair
+    # is shared with `qfedx serve` (utils/host — r14).
+    sigterm_token = install_sigterm_interrupt()
     last_done, last_params = start_round, params
     try:
         for rnd in range(start_round, num_rounds):
@@ -1444,14 +1434,6 @@ def train_federated_streamed(
             except Exception:  # noqa: BLE001 — best-effort unwind
                 pass
         pending_late.clear()
-        if in_main:
-            try:
-                signal.signal(
-                    signal.SIGTERM,
-                    prev_sigterm if prev_sigterm is not None
-                    else signal.SIG_DFL,
-                )
-            except (ValueError, TypeError, OSError):
-                pass
+        restore_sigterm(sigterm_token)
     result.params = params
     return result

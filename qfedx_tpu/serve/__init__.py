@@ -1,0 +1,43 @@
+"""Serving: persistent compiled forward + request micro-batching.
+
+The inference half of the north star (r14; docs/SERVING.md):
+
+- ``serve.forward.persistent_forward`` — the process-wide compiled-
+  forward cache shared by evaluation and serving.
+- ``serve.engine.ServeEngine`` — bucketed, warmed, retried dispatch of
+  the production engine route from a restored checkpoint.
+- ``serve.batcher.MicroBatcher`` — latency-budgeted batching, bounded-
+  queue shedding, graceful drain.
+
+CLI: ``python -m qfedx_tpu serve --run-dir runs/<name>``.
+"""
+
+from qfedx_tpu.serve.batcher import (
+    Future,
+    MicroBatcher,
+    Overloaded,
+    RequestError,
+    ShuttingDown,
+)
+from qfedx_tpu.serve.engine import (
+    ServeConfig,
+    ServeEngine,
+    engine_from_run_dir,
+    feature_shape_for,
+    infer_num_classes,
+)
+from qfedx_tpu.serve.forward import persistent_forward
+
+__all__ = [
+    "Future",
+    "MicroBatcher",
+    "Overloaded",
+    "RequestError",
+    "ServeConfig",
+    "ServeEngine",
+    "ShuttingDown",
+    "engine_from_run_dir",
+    "feature_shape_for",
+    "infer_num_classes",
+    "persistent_forward",
+]

@@ -1,0 +1,342 @@
+"""The serving engine: persistent compiled forward over bucketed batches.
+
+The inference half of the north star ("serves heavy traffic from
+millions of users", ROADMAP.md). The training loop got pipelined (r09),
+hierarchical (r10) and fault-tolerant (r11–r13); this is the first
+component that ANSWERS with the trained model. Design constraints, in
+order:
+
+1. **No request ever pays a compile.** Batch shapes are restricted to a
+   small ordered set of BUCKETS; ``warmup()`` traces and compiles every
+   bucket through the persistent-forward cache (``serve/forward.py`` —
+   the same wrapper evaluation uses, so a process that trained/evaluated
+   already owns some of the executables) before the first request is
+   accepted, and the serving loop then only ever replays warm
+   executables. ``tests/test_serve.py`` pins zero compile events inside
+   the loop via the obs compile-attribution listener.
+2. **Padding must be invisible.** A batch of m requests padded to bucket
+   b runs m real rows + (b−m) zero rows; every per-sample engine route
+   is row-independent, so the real rows' logits are BIT-IDENTICAL to the
+   unpadded forward (pinned f32 + bf16), and padded rows are sliced off
+   BEFORE any softmax/readout post-processing — a pad row can never leak
+   into a response.
+3. **Transient device errors retry, poisoned batches don't ship.** The
+   compute dispatch runs under the shared seeded-jitter retry policy
+   (``utils/retry``), with the ``serve.compute`` fault site
+   (``utils/faults``, QFEDX_FAULTS) injected inside the attempt so the
+   recovery path is deterministically testable. Malformed/non-finite
+   REQUESTS are the batcher's problem (``serve.request`` site): they are
+   rejected per-request before a batch is formed.
+
+Spans: ``serve.warmup`` (per-bucket compile), ``serve.pad`` (bucket
+selection + zero-fill), ``serve.compute`` (dispatch), ``serve.fetch``
+(the one blocking device→host read). docs/SERVING.md is the operator
+guide; docs/OBSERVABILITY.md has the pin table rows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from qfedx_tpu import obs
+from qfedx_tpu.serve.forward import persistent_forward
+from qfedx_tpu.utils import faults, pins
+from qfedx_tpu.utils.retry import retry_with_deadline
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Serving knobs. ``resolve()`` fills unset fields from the
+    QFEDX_SERVE_* pins so CLI flags > pins > defaults, the same
+    precedence as pipeline_depth (run/config.py)."""
+
+    # Ascending batch shapes compiled at warmup; a request batch pads up
+    # to the smallest bucket that fits. Few buckets = few executables =
+    # cheap warmup; the largest bucket is the dispatch batch cap.
+    buckets: tuple[int, ...] = (1, 8, 32)
+    # Latency budget of the micro-batcher: a queued request waits at
+    # most this long for its bucket to fill before being dispatched
+    # anyway (the deadline flush).
+    deadline_ms: float = 5.0
+    # Bounded admission queue: submissions past this depth are SHED
+    # (Overloaded) instead of growing an unbounded latency tail.
+    max_queue: int = 256
+    # Stated SLO for bench/ops rows (docs/SERVING.md): throughput_at_slo
+    # is the highest offered load whose p95 latency stays under this.
+    slo_ms: float = 50.0
+
+    def __post_init__(self):
+        if not self.buckets:
+            raise ValueError("buckets must be non-empty")
+        if any(b < 1 for b in self.buckets):
+            raise ValueError(f"bucket sizes must be >= 1, got {self.buckets}")
+        if tuple(sorted(set(self.buckets))) != tuple(self.buckets):
+            raise ValueError(
+                f"buckets must be strictly ascending, got {self.buckets}"
+            )
+        if not self.deadline_ms > 0:
+            raise ValueError(f"deadline_ms={self.deadline_ms} must be > 0")
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue={self.max_queue} must be >= 1")
+        if not self.slo_ms > 0:
+            raise ValueError(f"slo_ms={self.slo_ms} must be > 0")
+
+    @classmethod
+    def resolve(
+        cls,
+        buckets: tuple[int, ...] | None = None,
+        deadline_ms: float | None = None,
+        max_queue: int | None = None,
+        slo_ms: float | None = None,
+    ) -> "ServeConfig":
+        return cls(
+            buckets=(
+                tuple(buckets) if buckets is not None
+                else pins.int_list_pin("QFEDX_SERVE_BUCKETS", cls.buckets)
+            ),
+            deadline_ms=(
+                deadline_ms if deadline_ms is not None
+                else pins.float_pin("QFEDX_SERVE_DEADLINE_MS", cls.deadline_ms)
+            ),
+            max_queue=(
+                max_queue if max_queue is not None
+                else pins.int_pin("QFEDX_SERVE_QUEUE", cls.max_queue)
+            ),
+            slo_ms=(
+                slo_ms if slo_ms is not None
+                else pins.float_pin("QFEDX_SERVE_SLO_MS", cls.slo_ms)
+            ),
+        )
+
+
+class ServeEngine:
+    """Persistent compiled forward + bucketed padding + retried dispatch.
+
+    ``model``: a host-callable ``models.api.Model`` (sv-sharded models
+    need a mesh-wrapped apply and are rejected — serving them is a
+    front-end away once ``host_apply`` is passed as the forward).
+    ``params``: the restored parameter pytree (``engine_from_run_dir``).
+    ``feature_shape``: per-request feature shape, e.g. ``(n_qubits,)``
+    for angle-encoded VQCs, ``(28, 28)`` for image models.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        feature_shape: tuple[int, ...],
+        config: ServeConfig | None = None,
+        apply_fn=None,
+    ):
+        if apply_fn is None and getattr(model, "sv_size", 1) > 1:
+            raise ValueError(
+                f"model {model.name} is sv-sharded; its bare apply has "
+                "collectives that cannot run outside a shard_map — pass "
+                "apply_fn=host_apply(model, mesh)"
+            )
+        self.model = model
+        self.params = params
+        self.feature_shape = tuple(int(s) for s in feature_shape)
+        self.config = config or ServeConfig.resolve()
+        # THE shared wrapper (serve/forward.py): evaluation and serving
+        # hit one executable cache per (model, route).
+        self._fwd = persistent_forward(
+            apply_fn if apply_fn is not None else model.apply
+        )
+        self._warm = False
+
+    # -- buckets -------------------------------------------------------------
+
+    @property
+    def max_bucket(self) -> int:
+        return self.config.buckets[-1]
+
+    def bucket_for(self, m: int) -> int:
+        """Smallest compiled bucket that fits ``m`` rows."""
+        for b in self.config.buckets:
+            if m <= b:
+                return b
+        raise ValueError(
+            f"batch of {m} exceeds the largest bucket "
+            f"{self.max_bucket}; the batcher must split it"
+        )
+
+    # -- warmup --------------------------------------------------------------
+
+    def warmup(self) -> dict[str, Any]:
+        """Compile every bucket shape ahead of traffic (through the
+        QFEDX_COMPILE_CACHE path when the CLI enabled it — a restarted
+        server re-warms from the persistent cache instead of re-tracing
+        XLA). Returns per-bucket wall + attributed compile seconds."""
+        per_bucket = {}
+        for b in self.config.buckets:
+            x = np.zeros((b,) + self.feature_shape, dtype=np.float32)
+            with obs.span("serve.warmup", bucket=b) as sp:
+                t0 = time.perf_counter()
+                out = np.asarray(self._fwd(self.params, x))
+                wall = time.perf_counter() - t0
+            if not np.all(np.isfinite(out)):
+                raise RuntimeError(
+                    f"warmup forward at bucket {b} produced non-finite "
+                    "logits — refusing to serve a broken checkpoint"
+                )
+            per_bucket[b] = {
+                "wall_s": round(wall, 4),
+                "compile_s": round(getattr(sp, "compile_s", 0.0), 4),
+            }
+        self._warm = True
+        obs.counter("serve.warmup_buckets", len(per_bucket))
+        return {"buckets": per_bucket, "num_classes": int(out.shape[-1])}
+
+    # -- inference -----------------------------------------------------------
+
+    def infer(self, x: np.ndarray, seq: int = 0) -> np.ndarray:
+        """Logits for ``x`` [m, *feature_shape], m ≤ max bucket.
+
+        Pads up to the bucket, dispatches the warm executable (retrying
+        transient errors — the ``serve.compute`` fault site fires inside
+        the attempt), fetches ONCE, and slices the pad rows off before
+        returning — they never reach readout post-processing.
+        """
+        x = np.asarray(x, dtype=np.float32)
+        m = x.shape[0]
+        bucket = self.bucket_for(m)
+        with obs.span("serve.pad", batch=m, bucket=bucket):
+            if m < bucket:
+                xb = np.zeros((bucket,) + x.shape[1:], dtype=x.dtype)
+                xb[:m] = x
+            else:
+                xb = x
+
+        def attempt(k: int):
+            if k > 0:
+                obs.counter("serve.compute_retries")
+            plan = faults.active_plan()
+            if plan is not None:
+                plan.check("serve.compute", seq, attempt=k)
+            out = self._fwd(self.params, xb)
+            # The fetch lives INSIDE the retried attempt: under async
+            # dispatch a device execution error only surfaces at the
+            # blocking device→host read, and a transient one must be
+            # retryable — retrying just the (non-blocking) dispatch
+            # would retry nothing real. serve.fetch nests under
+            # serve.compute in the trace.
+            with obs.span("serve.fetch", batch=m):
+                return np.asarray(out)
+
+        with obs.span("serve.compute", batch=m, bucket=bucket, seq=seq):
+            logits = retry_with_deadline(
+                attempt,
+                attempts=3,
+                base_delay_s=0.002,
+                max_delay_s=0.05,
+                deadline_s=5.0,
+                describe=f"serve compute (batch {seq})",
+                jitter_site=f"serve/{seq}",
+            )
+        obs.counter("serve.batches")
+        obs.counter("serve.requests_served", m)
+        return logits[:m]
+
+    def postprocess(self, logits: np.ndarray) -> dict[str, np.ndarray]:
+        """Softmax probabilities + predicted class for REAL rows only —
+        callers pass the already-sliced logits, so a pad row can never
+        enter the normalization."""
+        z = logits - logits.max(axis=-1, keepdims=True)
+        ez = np.exp(z)
+        probs = ez / ez.sum(axis=-1, keepdims=True)
+        return {"probs": probs, "pred": logits.argmax(axis=-1)}
+
+
+# -- checkpoint restore ------------------------------------------------------
+
+
+def infer_num_classes(cfg) -> int:
+    """num_classes implied by an ExperimentConfig without touching data:
+    an explicit class subset wins, else the dataset's full class count."""
+    from qfedx_tpu.data.datasets import SPECS
+
+    if cfg.data.classes is not None:
+        return len(cfg.data.classes)
+    return SPECS[cfg.data.dataset].num_classes
+
+
+def feature_shape_for(cfg) -> tuple[int, ...]:
+    """Per-request feature shape implied by an ExperimentConfig —
+    mirrors build_data's shaping (run/config.py)."""
+    from qfedx_tpu.data.datasets import SPECS
+
+    m = cfg.model
+    if m.model == "cnn":
+        spec = SPECS[cfg.data.dataset]
+        if spec.channels == 1:
+            return (spec.height, spec.width)
+        return (spec.height, spec.width, spec.channels)
+    if m.model == "vqc" and m.encoding == "amplitude":
+        return (1 << m.n_qubits,)
+    return (m.n_qubits,)
+
+
+def engine_from_run_dir(
+    run_dir: str | os.PathLike,
+    round_idx: int | None = None,
+    config: ServeConfig | None = None,
+) -> tuple[ServeEngine, dict[str, Any]]:
+    """Restore a trained run into a ServeEngine.
+
+    Rebuilds the model from the run dir's ``config.json`` (the
+    reproducibility contract of run/metrics.ExperimentRun) and loads
+    ``round_idx`` (or the newest last-good checkpoint — r13 integrity
+    fallback applies) via the ``Model`` contract. Returns the engine and
+    an info dict (restored round, model/run metadata).
+    """
+    import jax
+
+    from qfedx_tpu.run.checkpoint import Checkpointer
+    from qfedx_tpu.run.config import build_model, experiment_config_from_dict
+
+    run_dir = Path(run_dir)
+    cfg_path = run_dir / "config.json"
+    if not cfg_path.exists():
+        raise FileNotFoundError(
+            f"{cfg_path} not found — serve needs a tracked run directory "
+            "(one written by ExperimentRun / `qfedx_tpu train`)"
+        )
+    exp = experiment_config_from_dict(json.loads(cfg_path.read_text()))
+    num_classes = infer_num_classes(exp)
+    model = build_model(exp, num_classes)
+    if model.sv_size > 1:
+        raise NotImplementedError(
+            "serving sv-sharded models needs a mesh-wrapped forward; "
+            "restore on a pod and pass apply_fn=host_apply(model, mesh)"
+        )
+    template = model.init(jax.random.PRNGKey(exp.seed))
+    ckpt = Checkpointer(run_dir / "checkpoints", every=1)
+    if round_idx is not None:
+        params = ckpt.restore(round_idx, template)
+        restored = round_idx
+    else:
+        got = ckpt.restore_latest(template)
+        if got is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {run_dir / 'checkpoints'} — train "
+                "with --checkpoint-every, or pass --round to pick one"
+            )
+        params, restored = got
+    engine = ServeEngine(
+        model, params, feature_shape_for(exp), config=config
+    )
+    info = {
+        "round": restored,
+        "model": model.name,
+        "num_classes": num_classes,
+        "run_dir": str(run_dir),
+    }
+    return engine, info

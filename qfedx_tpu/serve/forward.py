@@ -1,0 +1,109 @@
+"""The persistent-forward cache: ONE jitted forward per model per route.
+
+Before r14 every inference call-site built its own ``@jax.jit`` wrapper
+around ``model.apply`` — the trainer alone builds two evaluators per run
+(capped + full, run/trainer.py), a sweep builds two per cell, and each
+wrapper carries its own empty executable cache. The serving engine makes
+per-callsite wrappers untenable: a request must never pay a compile, so
+the warmed executables have to be THE executables every other caller
+hits (docs/PERF.md §15d records the honest boundary of the wall-clock
+claim — jax's internal caches already dedup same-callable re-jits; what
+this cache guarantees is artifact identity and route correctness).
+
+``persistent_forward(fwd)`` returns a process-wide shared ``jax.jit``
+wrapper for ``fwd``, keyed on:
+
+- the ``fwd`` callable itself — the per-route wrappers are ANCHORED on
+  the function object (a cache dict in its ``__dict__``), so their
+  lifetime is exactly the model's: drop the model and the closure, the
+  wrapper cycle is garbage-collected, and the compiled executables are
+  freed. No global registry that could pin a sweep's dead models (a
+  global WeakKeyDictionary cannot work here: its values would hold the
+  key alive through ``jax.jit``'s own reference and nothing would ever
+  evict);
+- the engine-routing pins (QFEDX_DTYPE / QFEDX_FUSE / QFEDX_BATCHED /
+  QFEDX_GATE_FORM / QFEDX_SLAB_LANES / QFEDX_FOLD_CLIENTS), resolved
+  PER CALL: the pins are read at trace time, so one jit wrapper used
+  across a pin flip would cache the flipped route's executable under
+  the old identity (the bench's with_env A/B levers flip pins around
+  fixed models and long-lived evaluators — a shape-keyed jit cache
+  would silently hand them the stale program, the wrong-path-measured
+  error class of ADVICE r04). The returned facade dispatches each call
+  to the current route's wrapper.
+
+jax.jit itself caches one executable per input shape/dtype under the
+wrapper, which is exactly the serving contract: warmup compiles every
+bucket shape once, and every later call — from the batcher, from an
+evaluator, from bench — is a cache hit on the same executable.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable
+
+import jax
+
+# Pins consulted while TRACING an engine program (build-time routing).
+# Per-call pins (QFEDX_TRACE, QFEDX_FAULTS) do not shape the program and
+# deliberately do not key the cache.
+_ROUTING_PINS = (
+    "QFEDX_DTYPE",
+    "QFEDX_FUSE",
+    "QFEDX_BATCHED",
+    "QFEDX_GATE_FORM",
+    "QFEDX_SLAB_LANES",
+    "QFEDX_FOLD_CLIENTS",
+)
+
+# Attribute on the forward callable holding its {routing_key: wrapper}
+# dict. Anchoring on the callable (instead of a module-global map) makes
+# wrapper lifetime follow model lifetime (module docstring).
+_ATTR = "_qfedx_persistent_forward"
+_LOCK = threading.Lock()
+
+
+def _routing_key() -> tuple:
+    return tuple(os.environ.get(p, "") for p in _ROUTING_PINS)
+
+
+def persistent_forward(fwd: Callable) -> Callable:
+    """THE shared forward for ``fwd``: one facade per callable, which
+    resolves the routing key PER CALL and dispatches to the per-route
+    ``jax.jit`` wrapper. Per-call resolution matters: an evaluator
+    binds its forward once at build time and may be called inside a
+    with_env pin window later — a wrapper frozen to its build-time
+    route would then cache the flipped route's executable under the
+    old key and serve it to post-restore callers. The six env reads
+    cost ~µs per call, the same order as the obs span guard (PERF §13).
+
+    Falls back to a fresh ``jax.jit`` for callables without a writable
+    ``__dict__`` (exotic callables — the cache is an optimization,
+    never a requirement)."""
+    with _LOCK:
+        shared = getattr(fwd, _ATTR, None)
+        if shared is not None:
+            return shared
+        routes: dict = {}
+
+        def shared(*args, **kwargs):
+            key = _routing_key()
+            with _LOCK:
+                wrapper = routes.get(key)
+                if wrapper is None:
+                    wrapper = routes[key] = jax.jit(fwd)
+            return wrapper(*args, **kwargs)
+
+        shared._routes = routes
+        try:
+            setattr(fwd, _ATTR, shared)
+        except (AttributeError, TypeError):
+            return jax.jit(fwd)
+        return shared
+
+
+def cached_routes(fwd: Callable) -> int:
+    """Routes compiled for ``fwd``'s shared forward — tests only."""
+    shared = getattr(fwd, _ATTR, None)
+    return len(shared._routes) if shared is not None else 0

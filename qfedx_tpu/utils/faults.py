@@ -57,6 +57,15 @@ test harness):
   round, wave=peer)`` per peer and folds firing peers into the round's
   survivor mask, so the casualty's ring partner lives on the OTHER
   process (tests/_distributed_worker.py dropout mode).
+- ``serve.request`` — per-request corruption at the serving front door
+  (r14): ``kind`` ``nan`` (features go non-finite) / ``malformed``
+  (wrong feature shape). The micro-batcher mutates request #seq (the
+  ``rounds`` coordinate is the request sequence) BEFORE validation, so
+  the per-request 4xx rejection is exercised organically and a bad
+  request can never poison its co-batched rows (serve/batcher.py).
+- ``serve.compute`` — transient device error inside the serving
+  engine's dispatch (the round coordinate is the batch sequence);
+  retried under the shared seeded-jitter policy (serve/engine.py).
 
 Rule spec (JSON or dict) — ``docs/ROBUSTNESS.md`` is the reference:
 
@@ -105,6 +114,9 @@ SITES = (
     # r13 straggler sites (appended for the same reason).
     "client.slow",
     "wave.delay",
+    # r14 serving sites (appended for the same reason).
+    "serve.request",
+    "serve.compute",
 )
 CLIENT_KINDS = ("drop", "nan", "inf")
 # Byzantine base kinds; scale REQUIRES a parameter ("scale:100"), noise
@@ -113,12 +125,18 @@ BYZANTINE_KINDS = ("scale", "sign_flip", "noise", "label_flip")
 # Straggler kinds (r13): slow takes optional seconds ("slow" = 1 s,
 # "slow:0.5"); delay REQUIRES them ("delay:0.5").
 SLOW_KINDS = ("slow",)
+# Serving request corruptions (r14): the batcher MUTATES request #seq
+# (nan = non-finite features, malformed = wrong feature shape) so the
+# per-request rejection path is exercised through real validation — a
+# mutation site like wave.delay, not an error site.
+SERVE_REQUEST_KINDS = ("nan", "malformed")
 _PER_CLIENT_SITES = ("client.compute", "client.byzantine", "client.slow")
-# wave.delay is neither per-client nor an error site: it returns a
-# DURATION (wave_delay_s) instead of raising, so check() rejects it.
+# wave.delay returns a DURATION and serve.request returns a MUTATION
+# (instead of raising), so check() rejects both — they are consulted
+# through their own accessors, not the error-site path.
 _ERROR_SITES = tuple(
     s for s in SITES
-    if s not in _PER_CLIENT_SITES and s != "wave.delay"
+    if s not in _PER_CLIENT_SITES and s not in ("wave.delay", "serve.request")
 )
 
 
@@ -133,6 +151,7 @@ def doc_taxonomy() -> dict[str, tuple[str, ...]]:
         "client.byzantine": ("scale:k", "sign_flip", "noise", "label_flip"),
         "client.slow": ("slow:s",),
         "wave.delay": ("delay:s",),
+        "serve.request": SERVE_REQUEST_KINDS,
     }
     return {s: kinds.get(s, ("error",)) for s in SITES}
 
@@ -256,6 +275,12 @@ class _Rule:
                     f"delay seconds must be > 0, got {self.kind!r}"
                 )
             self.kind = base
+        elif self.site == "serve.request":
+            if self.kind not in SERVE_REQUEST_KINDS:
+                raise ValueError(
+                    f"serve.request kind {self.kind!r} not in "
+                    f"{SERVE_REQUEST_KINDS}"
+                )
         elif self.kind != "error":
             raise ValueError(
                 f"{self.site} supports only kind='error', got {self.kind!r}"
@@ -292,6 +317,18 @@ class _Rule:
                 "error — 'times' (the retry-attempt bound) does not "
                 "apply"
             )
+        if self.site == "serve.request":
+            # Per-REQUEST mutation: the round coordinate is the request
+            # sequence number; clients/waves/times have no meaning and
+            # accepting-but-ignoring them would be the silent-no-fire
+            # class the loud grammar exists to prevent.
+            for bad in ("clients", "waves", "times"):
+                if spec.get(bad) is not None:
+                    raise ValueError(
+                        f"serve.request draws per request sequence: "
+                        f"restrict with 'rounds' (= request seqs) or "
+                        f"'rate', not {bad!r}"
+                    )
         if self.site in _PER_CLIENT_SITES:
             if (self.rate is None) == (self.clients is None):
                 raise ValueError(
@@ -527,6 +564,29 @@ class FaultPlan:
                 self.wave_delay_s(round_idx, w),
             )
         return out
+
+    # -- serving sites (r14) -------------------------------------------------
+
+    def request_mutation(self, seq: int) -> str | None:
+        """Mutation kind for serving request #``seq`` at the
+        ``serve.request`` site — ``"nan"`` / ``"malformed"`` / None.
+        The batcher applies the mutation BEFORE validation, so the
+        per-request rejection (the 4xx path) is exercised through the
+        same code real bad traffic hits. Per-coordinate coin like
+        ``wave_delay_s``'s, salted per rule position; the first firing
+        rule wins (rule order is the plan author's precedence)."""
+        for idx, rule in enumerate(self.rules):
+            if rule.site != "serve.request" or not rule.applies(seq, 0):
+                continue
+            u = _uniform(
+                self.seed + 7919 * (idx + 1), "serve.request", seq, 0, [0]
+            )[0]
+            if u < float(rule.rate):
+                from qfedx_tpu import obs
+
+                obs.counter("faults.injected.serve.request")
+                return rule.kind
+        return None
 
     # -- error sites ---------------------------------------------------------
 

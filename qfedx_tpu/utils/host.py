@@ -17,3 +17,48 @@ import jax
 def is_primary() -> bool:
     """True on the process that owns host-side IO (process 0)."""
     return jax.process_index() == 0
+
+
+def install_sigterm_interrupt():
+    """Translate SIGTERM into ``KeyboardInterrupt("SIGTERM")`` so an
+    orchestrator's TERM drains exactly like a Ctrl-C (the r13 graceful-
+    shutdown discipline, shared by the streamed trainer and ``qfedx
+    serve`` — one hardened copy, because the first duplicate had
+    already drifted on the restore path).
+
+    Returns an opaque token for ``restore_sigterm``; None when no
+    handler was installed (non-main thread, or an exotic embedding
+    where ``signal.signal`` is rejected) — the caller simply runs
+    unguarded then.
+    """
+    import signal
+    import threading
+
+    if threading.current_thread() is not threading.main_thread():
+        return None
+
+    def _on_sigterm(signum, frame):
+        raise KeyboardInterrupt("SIGTERM")
+
+    try:
+        prev = signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):  # signals unavailable; run unguarded
+        return None
+    return (prev,)
+
+
+def restore_sigterm(token) -> None:
+    """Undo ``install_sigterm_interrupt``. A previous handler installed
+    outside Python reads back as None — restore SIG_DFL then, never
+    leave our raise-KeyboardInterrupt handler behind."""
+    if token is None:
+        return
+    import signal
+
+    (prev,) = token
+    try:
+        signal.signal(
+            signal.SIGTERM, prev if prev is not None else signal.SIG_DFL
+        )
+    except (ValueError, TypeError, OSError):
+        pass

@@ -48,6 +48,52 @@ def bool_pin(name: str, default: bool | Callable[[], bool]) -> bool:
     return val
 
 
+def float_pin(name: str, default: float) -> float:
+    """Resolve a float-valued pin (QFEDX_SERVE_DEADLINE_MS /
+    QFEDX_SERVE_SLO_MS) with the family's loud grammar: unset → default,
+    a parseable number → that value, anything else raises (the
+    wrong-path-measured guard — see module docstring)."""
+    env = os.environ.get(name)
+    if env is None:
+        return default
+    try:
+        return float(env)
+    except ValueError:
+        raise ValueError(f"{name}={env!r}: expected a number") from None
+
+
+def int_pin(name: str, default: int) -> int:
+    """Resolve a non-negative-integer pin (QFEDX_SERVE_QUEUE) loudly:
+    unset → default, digits → that value, anything else raises. Range
+    constraints beyond non-negativity belong to the consuming config's
+    validation, where the explicit-argument path hits them too."""
+    env = os.environ.get(name)
+    if env is None:
+        return default
+    if not env.isdigit():
+        raise ValueError(f"{name}={env!r}: expected a non-negative integer")
+    return int(env)
+
+
+def int_list_pin(name: str, default: tuple[int, ...]) -> tuple[int, ...]:
+    """Resolve a comma-separated integer-list pin (QFEDX_SERVE_BUCKETS)
+    loudly: unset → default, ``"1,8,32"`` → (1, 8, 32), anything else
+    (including an empty value) raises."""
+    env = os.environ.get(name)
+    if env is None:
+        return default
+    try:
+        out = tuple(int(tok) for tok in env.split(",") if tok.strip())
+    except ValueError:
+        out = ()
+    if not out:
+        raise ValueError(
+            f"{name}={env!r}: expected comma-separated integers, "
+            "e.g. '1,8,32'"
+        )
+    return out
+
+
 def depth_pin(name: str, default: int, on_value: int = 1) -> int:
     """Resolve an integer-depth pin with the on/off grammar as a prefix:
     ``0``/``off`` → 0, ``1``/``on`` → ``on_value``, a bare integer → that

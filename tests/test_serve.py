@@ -1,0 +1,551 @@
+"""Serving subsystem (r14): engine padding parity, warm-bucket compile
+contract, micro-batcher flush/shed/drain discipline, fault sites, the
+shared persistent-forward cache, and the CLI round trip.
+
+Shapes are tiny (4 qubits, 1 layer) — tier-1 budget discipline: the
+serving invariants are shape-independent, and the dense-width serving
+numbers are bench.py's job (`_bench_serve`), not a unit test's.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from qfedx_tpu import obs
+from qfedx_tpu.models.vqc import make_vqc_classifier
+from qfedx_tpu.serve import (
+    MicroBatcher,
+    Overloaded,
+    RequestError,
+    ServeConfig,
+    ServeEngine,
+    ShuttingDown,
+    engine_from_run_dir,
+    persistent_forward,
+)
+from qfedx_tpu.utils.faults import FaultPlan
+from qfedx_tpu.utils.retry import RetryExhausted
+
+N = 4
+FEATS = (N,)
+
+
+def _engine(buckets=(1, 2, 4), deadline_ms=150.0, max_queue=8, seed=0):
+    model = make_vqc_classifier(n_qubits=N, n_layers=1, num_classes=2)
+    params = model.init(jax.random.PRNGKey(seed))
+    cfg = ServeConfig(
+        buckets=buckets, deadline_ms=deadline_ms, max_queue=max_queue
+    )
+    return ServeEngine(model, params, FEATS, config=cfg), model, params
+
+
+def _rows(m, seed=0):
+    return np.random.default_rng(seed).uniform(0, 1, (m, N)).astype(
+        np.float32
+    )
+
+
+# -- config / pin grammar ------------------------------------------------------
+
+
+def test_serve_config_validation():
+    with pytest.raises(ValueError, match="ascending"):
+        ServeConfig(buckets=(4, 2))
+    with pytest.raises(ValueError, match="ascending"):
+        ServeConfig(buckets=(2, 2))
+    with pytest.raises(ValueError, match="non-empty"):
+        ServeConfig(buckets=())
+    with pytest.raises(ValueError, match="deadline_ms"):
+        ServeConfig(deadline_ms=0)
+    with pytest.raises(ValueError, match="max_queue"):
+        ServeConfig(max_queue=0)
+
+
+def test_serve_pins_resolve_and_reject(monkeypatch):
+    monkeypatch.setenv("QFEDX_SERVE_BUCKETS", "2,16")
+    monkeypatch.setenv("QFEDX_SERVE_DEADLINE_MS", "7.5")
+    monkeypatch.setenv("QFEDX_SERVE_QUEUE", "9")
+    cfg = ServeConfig.resolve()
+    assert cfg.buckets == (2, 16)
+    assert cfg.deadline_ms == 7.5 and cfg.max_queue == 9
+    # explicit args beat pins (CLI > pin > default)
+    assert ServeConfig.resolve(buckets=(4,)).buckets == (4,)
+    monkeypatch.setenv("QFEDX_SERVE_BUCKETS", "fast")
+    with pytest.raises(ValueError, match="QFEDX_SERVE_BUCKETS"):
+        ServeConfig.resolve()
+    monkeypatch.setenv("QFEDX_SERVE_BUCKETS", "2,16")
+    monkeypatch.setenv("QFEDX_SERVE_QUEUE", "-3")
+    with pytest.raises(ValueError, match="QFEDX_SERVE_QUEUE"):
+        ServeConfig.resolve()
+
+
+# -- padding parity (ISSUE r14 satellite) --------------------------------------
+
+
+@pytest.mark.parametrize("dtype", ["f32", "bf16"])
+def test_padding_parity_bit_identical(monkeypatch, dtype):
+    """A batch padded up to a bucket must answer the REAL rows
+    bit-identically to the unpadded forward — every engine route is
+    row-independent, so padding is purely shape plumbing; and the pad
+    rows are sliced off before any readout post-processing."""
+    if dtype == "bf16":
+        monkeypatch.setenv("QFEDX_DTYPE", "bf16")
+    engine, model, params = _engine(buckets=(8,))
+    x = _rows(3)
+    padded = engine.infer(x)
+    exact = np.asarray(persistent_forward(model.apply)(params, x))
+    assert padded.shape == (3, 2)
+    assert np.array_equal(padded, exact), (
+        f"{dtype}: padded bucket forward != unpadded forward on real rows"
+    )
+    # postprocess normalizes over the already-sliced rows only
+    post = engine.postprocess(padded)
+    assert post["probs"].shape == (3, 2)
+    assert np.allclose(post["probs"].sum(axis=1), 1.0, atol=1e-6)
+
+
+def test_pad_rows_never_reach_responses():
+    engine, model, params = _engine(buckets=(4,))
+    with MicroBatcher(engine) as b:
+        futs = [b.submit(r) for r in _rows(2)]
+        out = [f.result(timeout=30) for f in futs]
+    assert len(out) == 2
+    for rec in out:
+        assert rec["logits"].shape == (2,)
+        assert np.all(np.isfinite(rec["probs"]))
+
+
+# -- warmup / zero-compile contract --------------------------------------------
+
+
+def test_warmup_compiles_every_bucket_no_compile_in_loop(monkeypatch):
+    """The serving-loop compile contract, asserted via the obs
+    compile-attribution listener (r08): warmup's spans absorb all
+    compile time; every serve.compute span after it carries
+    compile_s == 0 and the compile.* counters do not move."""
+    monkeypatch.setenv("QFEDX_TRACE", "1")
+    obs.reset()
+    engine, _, _ = _engine(buckets=(1, 2, 4), deadline_ms=30.0)
+    warm = engine.warmup()
+    assert set(warm["buckets"]) == {1, 2, 4}
+
+    def compile_total():
+        return sum(
+            v for k, v in obs.registry().counters.items()
+            if k.startswith("compile.")
+        )
+
+    compiled_at_warmup = compile_total()
+    assert compiled_at_warmup > 0, "warmup should have compiled the buckets"
+    with MicroBatcher(engine) as b:
+        futs = [b.submit(r) for r in _rows(1)]
+        futs += [b.submit(r) for r in _rows(2, seed=1)]
+        futs += [b.submit(r) for r in _rows(4, seed=2)]
+        for f in futs:
+            f.result(timeout=30)
+    assert compile_total() == compiled_at_warmup, (
+        "a compile fired inside the serving loop"
+    )
+    compute_spans = [
+        s for s in obs.registry().spans if s.name == "serve.compute"
+    ]
+    assert compute_spans, "serving should have recorded serve.compute spans"
+    assert all(s.compile_s == 0.0 for s in compute_spans)
+
+
+def test_eval_and_serving_share_one_compiled_artifact(monkeypatch):
+    """The r14 eval satellite: make_evaluator instances and the serve
+    engine route through ONE persistent-forward wrapper per (model,
+    route) — building a second evaluator (the trainer's capped + full
+    pair) or warming a same-shaped bucket triggers NO new compile."""
+    from qfedx_tpu.fed.evaluate import make_evaluator
+
+    monkeypatch.setenv("QFEDX_TRACE", "1")
+    obs.reset()
+    model = make_vqc_classifier(n_qubits=N, n_layers=1, num_classes=2)
+    params = model.init(jax.random.PRNGKey(0))
+    x, y = _rows(6), np.array([0, 1] * 3)
+
+    def compile_total():
+        return sum(
+            v for k, v in obs.registry().counters.items()
+            if k.startswith("compile.")
+        )
+
+    ev_full = make_evaluator(model, batch_size=4)
+    ev_full(params, x, y)
+    first = compile_total()
+    assert first > 0
+    ev_capped = make_evaluator(model, batch_size=4, max_batches=1)
+    ev_capped(params, x, y)
+    assert compile_total() == first, (
+        "second evaluator recompiled the same forward (the pre-r14 "
+        "duplicate-compile leak)"
+    )
+    cfg = ServeConfig(buckets=(4,), deadline_ms=10.0, max_queue=8)
+    engine = ServeEngine(model, params, FEATS, config=cfg)
+    engine.warmup()
+    assert compile_total() == first, (
+        "serve warmup recompiled the evaluator's executable"
+    )
+
+
+def test_forward_cache_frees_dropped_models():
+    """The cache must not pin dead models: wrappers are anchored on the
+    forward callable itself, so dropping the model collects the whole
+    cycle (a global registry holding wrappers would keep every sweep
+    cell's executables alive forever)."""
+    import gc
+    import weakref
+
+    model = make_vqc_classifier(n_qubits=N, n_layers=1, num_classes=2)
+    ref = weakref.ref(model.apply)
+    assert persistent_forward(model.apply) is persistent_forward(model.apply)
+    del model
+    gc.collect()
+    assert ref() is None, (
+        "dropped model's forward is still pinned by the persistent-"
+        "forward cache"
+    )
+
+
+def test_forward_cache_is_route_keyed(monkeypatch):
+    """The shared forward resolves the routing pins PER CALL: a forward
+    bound before a pin flip (an evaluator built outside a with_env
+    window, called inside it) dispatches to the flipped route, and the
+    flip never contaminates the original route's executable."""
+    from qfedx_tpu.serve.forward import cached_routes
+
+    model = make_vqc_classifier(n_qubits=N, n_layers=1, num_classes=2)
+    params = model.init(jax.random.PRNGKey(0))
+    shared = persistent_forward(model.apply)
+    assert persistent_forward(model.apply) is shared
+    x = _rows(2)
+    f32_out = np.asarray(shared(params, x))
+    assert cached_routes(model.apply) == 1
+    monkeypatch.setenv("QFEDX_DTYPE", "bf16")
+    shared(params, x)  # same facade, dispatches to a NEW route wrapper
+    assert cached_routes(model.apply) == 2, (
+        "pin flip did not resolve to its own route wrapper"
+    )
+    monkeypatch.delenv("QFEDX_DTYPE")
+    assert np.array_equal(np.asarray(shared(params, x)), f32_out), (
+        "original route's executable was contaminated by the pin flip"
+    )
+    assert cached_routes(model.apply) == 2  # restored route re-used, not re-jitted
+
+
+# -- micro-batcher flush / shed / drain ----------------------------------------
+
+
+def test_bucket_full_flush_beats_deadline():
+    engine, _, _ = _engine(buckets=(1, 2, 4), deadline_ms=5000.0)
+    engine.warmup()
+    with MicroBatcher(engine) as b:
+        t0 = time.monotonic()
+        futs = [b.submit(r) for r in _rows(4)]
+        for f in futs:
+            f.result(timeout=30)
+        elapsed = time.monotonic() - t0
+    assert elapsed < 4.0, "a full bucket waited for the deadline"
+    assert b.stats["full_flushes"] >= 1
+    assert b.stats["deadline_flushes"] == 0
+
+
+def test_deadline_flush_fires_for_partial_bucket():
+    engine, _, _ = _engine(buckets=(4,), deadline_ms=150.0)
+    engine.warmup()
+    with MicroBatcher(engine) as b:
+        t0 = time.monotonic()
+        fut = b.submit(_rows(1)[0])
+        fut.result(timeout=30)
+        elapsed = time.monotonic() - t0
+    assert elapsed >= 0.05, (
+        "a lone request flushed before its deadline window"
+    )
+    assert b.stats["deadline_flushes"] >= 1
+    assert b.stats["full_flushes"] == 0
+
+
+def test_bounded_queue_sheds_with_exact_count():
+    engine, _, _ = _engine(buckets=(1,), deadline_ms=5.0, max_queue=2)
+    engine.warmup()
+    started, release = threading.Event(), threading.Event()
+    orig = engine.infer
+
+    def gated(x, seq=0):
+        started.set()
+        release.wait(timeout=30)
+        return orig(x, seq)
+
+    engine.infer = gated
+    b = MicroBatcher(engine).start()
+    try:
+        first = b.submit(_rows(1)[0])
+        assert started.wait(timeout=10)  # dispatcher now blocked in infer
+        queued = [b.submit(r) for r in _rows(2, seed=1)]  # fills max_queue
+        with pytest.raises(Overloaded):
+            b.submit(_rows(1, seed=2)[0])
+        assert b.stats["shed"] == 1
+    finally:
+        release.set()
+        b.close(drain=True)
+    for f in [first, *queued]:
+        assert f.result(timeout=30)["logits"].shape == (2,)
+
+
+def test_sigterm_drains_in_flight_requests():
+    """The CLI's shutdown discipline (mirrors run_serve): SIGTERM lands
+    as KeyboardInterrupt on the main thread, and the drain answers every
+    admitted request before exit — none dropped, none errored."""
+    import os
+    import signal as signal_mod
+
+    engine, _, _ = _engine(buckets=(2,), deadline_ms=50.0)
+    engine.warmup()
+    orig = engine.infer
+
+    def slow(x, seq=0):
+        time.sleep(0.05)
+        return orig(x, seq)
+
+    engine.infer = slow
+
+    def _on_sigterm(signum, frame):
+        raise KeyboardInterrupt("SIGTERM")
+
+    prev = signal_mod.signal(signal_mod.SIGTERM, _on_sigterm)
+    b = MicroBatcher(engine).start()
+    try:
+        futs = [b.submit(r) for r in _rows(5)]
+        with pytest.raises(KeyboardInterrupt, match="SIGTERM"):
+            os.kill(os.getpid(), signal_mod.SIGTERM)
+            time.sleep(5)  # the signal interrupts this sleep
+        b.close(drain=True)
+        assert all(f.done() for f in futs)
+        for f in futs:
+            assert f.result(timeout=1)["logits"].shape == (2,)
+    finally:
+        signal_mod.signal(signal_mod.SIGTERM, prev)
+        b.close(drain=True)
+    assert b.stats["served"] == 5
+
+
+def test_close_without_drain_fails_pending():
+    engine, _, _ = _engine(buckets=(1,), deadline_ms=10000.0, max_queue=8)
+    engine.warmup()
+    started, release = threading.Event(), threading.Event()
+    orig = engine.infer
+
+    def gated(x, seq=0):
+        started.set()
+        release.wait(timeout=30)
+        return orig(x, seq)
+
+    engine.infer = gated
+    b = MicroBatcher(engine).start()
+    head = b.submit(_rows(1)[0])
+    assert started.wait(timeout=10)
+    pending = [b.submit(r) for r in _rows(2, seed=1)]
+    release.set()
+    b.close(drain=False)
+    head.result(timeout=30)  # in-compute batch still completes
+    for f in pending:
+        with pytest.raises(ShuttingDown):
+            f.result(timeout=5)
+    with pytest.raises(ShuttingDown):
+        b.submit(_rows(1)[0])
+
+
+# -- fault sites (r14 robustness satellite) ------------------------------------
+
+
+def test_serve_request_fault_rejects_without_poisoning(monkeypatch):
+    """A serve.request NaN mutation fails ITS OWN submit (the 4xx); the
+    co-batched honest requests answer normally — the batch is never
+    poisoned (the serving sibling of the r11 quarantine)."""
+    plan = {"seed": 3, "rules": [
+        {"site": "serve.request", "kind": "nan", "rounds": [1]},
+    ]}
+    monkeypatch.setenv("QFEDX_FAULTS", json.dumps(plan))
+    engine, _, _ = _engine(buckets=(2,), deadline_ms=50.0)
+    engine.warmup()
+    rows = _rows(3)
+    with MicroBatcher(engine) as b:
+        ok0 = b.submit(rows[0])  # seq 0
+        with pytest.raises(RequestError, match="NaN"):
+            b.submit(rows[1])  # seq 1 — mutated by the plan
+        ok2 = b.submit(rows[2])  # seq 2
+        r0, r2 = ok0.result(timeout=30), ok2.result(timeout=30)
+    assert b.stats["rejected"] == 1 and b.stats["served"] == 2
+    assert np.all(np.isfinite(r0["logits"]))
+    assert np.all(np.isfinite(r2["logits"]))
+
+
+def test_serve_request_malformed_kind(monkeypatch):
+    plan = {"seed": 3, "rules": [
+        {"site": "serve.request", "kind": "malformed", "rounds": [0]},
+    ]}
+    monkeypatch.setenv("QFEDX_FAULTS", json.dumps(plan))
+    engine, _, _ = _engine(buckets=(1,))
+    with MicroBatcher(engine) as b:
+        with pytest.raises(RequestError, match="shape"):
+            b.submit(_rows(1)[0])
+
+
+def test_serve_request_rule_grammar():
+    for bad in ({"clients": [1]}, {"waves": [0]}, {"times": 1}):
+        with pytest.raises(ValueError, match="serve.request"):
+            FaultPlan(rules=[{"site": "serve.request", "kind": "nan", **bad}])
+    with pytest.raises(ValueError, match="serve.request kind"):
+        FaultPlan(rules=[{"site": "serve.request", "kind": "drop"}])
+    # serve.compute is a plain error site: error kind only, times applies
+    FaultPlan(rules=[{"site": "serve.compute", "times": 1}])
+    with pytest.raises(ValueError, match="serve.compute"):
+        FaultPlan(rules=[{"site": "serve.compute", "kind": "nan"}])
+
+
+def test_serve_compute_transient_retries_and_recovers(monkeypatch):
+    """times:1 fails attempt 0 of batch seq 1; the shared retry policy
+    (seeded jitter) recovers in place — the request still answers."""
+    plan = {"seed": 5, "rules": [
+        {"site": "serve.compute", "rounds": [1], "times": 1},
+    ]}
+    monkeypatch.setenv("QFEDX_FAULTS", json.dumps(plan))
+    engine, model, params = _engine(buckets=(2,))
+    engine.warmup()
+    out = engine.infer(_rows(2), seq=1)
+    assert np.array_equal(
+        out, np.asarray(persistent_forward(model.apply)(params, _rows(2)))
+    )
+
+
+def test_serve_compute_persistent_failure_surfaces(monkeypatch):
+    plan = {"seed": 5, "rules": [
+        {"site": "serve.compute", "rounds": [1]},  # every attempt
+    ]}
+    monkeypatch.setenv("QFEDX_FAULTS", json.dumps(plan))
+    engine, _, _ = _engine(buckets=(1, 2), deadline_ms=30.0)
+    engine.warmup()
+    with pytest.raises(RetryExhausted):
+        engine.infer(_rows(1), seq=1)
+    # through the batcher the error lands on the batch's futures, and
+    # the NEXT batch (seq 2) serves normally — no poisoned loop state
+    monkeypatch.setenv("QFEDX_FAULTS", json.dumps(plan))
+    with MicroBatcher(engine) as b:
+        f1 = b.submit(_rows(1)[0])
+        with pytest.raises(RetryExhausted):
+            f1.result(timeout=30)
+        f2 = b.submit(_rows(1, seed=1)[0])
+        assert np.all(np.isfinite(f2.result(timeout=30)["logits"]))
+
+
+# -- restore + CLI round trip --------------------------------------------------
+
+
+def _write_run_dir(tmp_path, seed=7):
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.run.checkpoint import Checkpointer
+    from qfedx_tpu.run.config import (
+        DataConfig,
+        ExperimentConfig,
+        ModelConfig,
+        build_model,
+    )
+    from qfedx_tpu.run.metrics import _jsonable
+
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="iris", classes=(0, 1), num_clients=2),
+        model=ModelConfig(model="vqc", n_qubits=N, n_layers=1),
+        fed=FedConfig(batch_size=8),
+        seed=seed,
+    )
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    (run_dir / "config.json").write_text(json.dumps(_jsonable(cfg)))
+    model = build_model(cfg, 2)
+    params = model.init(jax.random.PRNGKey(seed))
+    Checkpointer(run_dir / "checkpoints", every=1).save(3, params)
+    return run_dir, model, params, cfg
+
+
+def test_experiment_config_round_trip(tmp_path):
+    from qfedx_tpu.fed.config import DPConfig, FedConfig
+    from qfedx_tpu.run.config import (
+        DataConfig,
+        ExperimentConfig,
+        ModelConfig,
+        experiment_config_from_dict,
+    )
+    from qfedx_tpu.run.metrics import _jsonable
+
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="mnist", classes=(0, 1, 2)),
+        model=ModelConfig(model="vqc", n_qubits=6, encoding="reupload"),
+        fed=FedConfig(
+            batch_size=16, optimizer="adam", secure_agg=True,
+            dp=DPConfig(clip_norm=0.5, noise_multiplier=2.0),
+        ),
+        num_rounds=7,
+        name="rt",
+    )
+    back = experiment_config_from_dict(
+        json.loads(json.dumps(_jsonable(cfg)))
+    )
+    assert back == cfg
+    # forward compat: unknown keys warn and are dropped, not fatal
+    blob = json.loads(json.dumps(_jsonable(cfg)))
+    blob["model"]["hyperdrive"] = 11
+    with pytest.warns(RuntimeWarning, match="hyperdrive"):
+        back2 = experiment_config_from_dict(blob)
+    assert back2 == cfg
+
+
+def test_engine_from_run_dir_serves_checkpoint(tmp_path):
+    run_dir, model, params, _cfg = _write_run_dir(tmp_path)
+    engine, info = engine_from_run_dir(
+        run_dir, config=ServeConfig(buckets=(2,), deadline_ms=10.0)
+    )
+    assert info["round"] == 3 and info["num_classes"] == 2
+    x = _rows(2)
+    assert np.array_equal(
+        engine.infer(x),
+        np.asarray(persistent_forward(model.apply)(params, x)),
+    )
+    with pytest.raises(FileNotFoundError, match="config.json"):
+        engine_from_run_dir(tmp_path / "nope")
+
+
+def test_cli_serve_end_to_end(tmp_path, capsys):
+    """`qfedx serve` answers a JSONL stream from a restored checkpoint:
+    valid requests in order, malformed ones as per-request 400s."""
+    from qfedx_tpu.run.cli import build_parser, run_serve
+
+    run_dir, _, _, _ = _write_run_dir(tmp_path)
+    req_path = tmp_path / "req.jsonl"
+    out_path = tmp_path / "resp.jsonl"
+    req_path.write_text(
+        json.dumps({"id": "a", "features": [0.1] * N}) + "\n"
+        + json.dumps([0.5] * N) + "\n"
+        + json.dumps({"id": "bad", "features": [1.0, 2.0]}) + "\n"
+    )
+    args = build_parser().parse_args([
+        "serve", "--run-dir", str(run_dir), "--buckets", "2",
+        "--deadline-ms", "5", "--input", str(req_path),
+        "--output", str(out_path),
+    ])
+    summary = run_serve(args)
+    recs = [json.loads(l) for l in out_path.read_text().splitlines()]
+    assert [r["id"] for r in recs] == ["a", 1, "bad"]
+    assert "pred" in recs[0] and "probs" in recs[1]
+    assert recs[2]["code"] == 400 and "shape" in recs[2]["error"]
+    # served = engine-answered requests; responses = emitted JSONL lines
+    # (including the 400) — served + rejected reconciles, no double count
+    assert summary["served"] == 2 and summary["rejected"] == 1
+    assert summary["responses"] == 3 and summary["shed"] == 0
