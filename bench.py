@@ -44,6 +44,12 @@ Sections in ``bench_details.json`` (beyond the headline):
   composed row with QFEDX_GUARDS=off (pre-r11 program: no non-finite
   quarantine, no survivor machinery), so the guards' overhead stays
   measured head-to-head like the fold/fuse/pipeline levers.
+- ``fed16q_bf16_trace_on``: the r15 observability lever — the trainer-
+  path row under QFEDX_TRACE=1 (spans + compile attribution + per-row
+  phases + span histograms), head-to-head vs the identical trace-off
+  pipeline row; ``trace_overhead_vs_off`` is the measured end-to-end
+  cost of enabled tracing (PERF.md §13 pins only the disabled-span
+  microcost), ``vs_prev``-tracked.
 - ``fault_tolerance``: accuracy under injected client churn — the
   dropout_rate → accuracy degradation curve at 0/5/20% casualties per
   round (half drops, half NaN updates; utils/faults), streamed trainer;
@@ -978,17 +984,19 @@ def _bench_serve(jax, n_qubits=16, n_layers=3, requests_per_rate=384):
                     "offered_rps": round(rate, 1), "shed": shed,
                 }
                 continue
-            lat = sorted(
-                (f.done_t - f.submit_t) * 1e3 for f in futs
-            )
+            # Bounded histogram (r15): the log-bucketed quantiles the
+            # live /metrics endpoint serves — within one bucket-width
+            # (~10%) of the exact sorted-list percentile (pinned in
+            # tests/test_obs.py), fixed memory at any request count.
+            hist = obs.Histogram()
+            for f in futs:
+                hist.record((f.done_t - f.submit_t) * 1e3)
             wall = max(f.done_t for f in futs) - futs[0].submit_t
             rates[f"load_{frac}"] = {
                 "offered_rps": round(rate, 1),
                 "completed_rps": round(len(futs) / wall, 1),
-                # obs.percentile: the ONE quantile definition, shared
-                # with the serve CLI summary and the phase rollups.
-                "p50_ms": round(obs.percentile(lat, 0.50), 3),
-                "p95_ms": round(obs.percentile(lat, 0.95), 3),
+                "p50_ms": round(hist.percentile(0.50), 3),
+                "p95_ms": round(hist.percentile(0.95), 3),
                 "shed": shed,
                 "batches": b.stats["batches"],
             }
@@ -1005,6 +1013,12 @@ def _bench_serve(jax, n_qubits=16, n_layers=3, requests_per_rate=384):
             "buckets": list(cfg.buckets),
             "deadline_ms": cfg.deadline_ms,
             "slo_ms": cfg.slo_ms,
+            # Stated so the first post-r15 vs_prev is readable: p50/p95
+            # switched from exact sorted-list percentiles to histogram
+            # LOWER-EDGE quantiles (<= one ~10% bucket below exact), so
+            # that round's serve_p50/p95 delta includes a one-time
+            # definitional shift, not a real latency change.
+            "quantile_definition": "histogram lower-edge (r15)",
             "warmup": warm["buckets"],
             "batch_s_max_bucket": round(batch_s, 5),
             "capacity_rps": round(capacity, 1),
@@ -1431,6 +1445,40 @@ def main():
             / fed16_bf16["client_rounds_per_s"],
             3,
         )
+    # The r15 tracing lever: the SAME trainer-path row with QFEDX_TRACE
+    # on — per-round spans, compile attribution, per-row phases merged
+    # into the JSONL, per-span histograms. PERF.md §13 pins only the
+    # ~3.5 µs disabled-span microcost; this measures what enabling the
+    # whole exporter pipeline costs END-TO-END, head-to-head against
+    # fed16q_bf16_pipeline (identical loop, trace off), vs_prev-tracked.
+    def _fed16q_traced(j):
+        from qfedx_tpu import obs as _obs
+
+        _obs.reset()  # isolate this row's spans from earlier sections
+        try:
+            out = _with_env(
+                {"QFEDX_DTYPE": "bf16", "QFEDX_PIPELINE": "1",
+                 "QFEDX_TRACE": "1"},
+                _bench_fed16q_pipeline, j,
+            )
+            # Compact per-phase walls of the traced row (cold + hot run
+            # combined — the cold run's compile lands in dispatch's
+            # compile_s, which is the attribution story being priced).
+            out["phase_totals"] = _obs.phase_totals()
+        finally:
+            _obs.reset()  # later sections must not inherit these spans
+        return out
+
+    fed16_bf16_trace_on = safe(_fed16q_traced)
+    if (
+        "client_rounds_per_s" in fed16_bf16_trace_on
+        and "client_rounds_per_s" in fed16_bf16_pipeline
+    ):
+        fed16_bf16_trace_on["trace_overhead_vs_off"] = round(
+            fed16_bf16_pipeline["client_rounds_per_s"]
+            / fed16_bf16_trace_on["client_rounds_per_s"],
+            3,
+        )
     fed256 = safe(_bench_fed256)
     # r10: cohort size unbound from HBM — 4096 clients/round through
     # 256-client streamed waves on one chip (hierarchical partial/apply
@@ -1532,6 +1580,20 @@ def main():
                 (prev.get("straggler") or {}).get("acc_buffer_30pct"),
                 True,
             )
+            # The r15 enabled-tracing overhead, end-to-end: prev rows
+            # predate the lever, so the delta appears once both exist.
+            delta(
+                "fed16q_trace_on_client_rounds_per_s",
+                fed16_bf16_trace_on.get("client_rounds_per_s"),
+                (prev.get("fed16q_bf16_trace_on") or {}).get(
+                    "client_rounds_per_s"
+                ),
+                True,
+            )
+            # NOTE: r15 changed the serve quantile definition to
+            # histogram lower-edge (see _bench_serve) — the first
+            # vs_prev across that boundary carries a <= one-bucket
+            # (~10%) definitional shift in p50/p95.
             delta(
                 "serve_p50_ms",
                 serve.get("serve_p50_ms"),
@@ -1622,6 +1684,7 @@ def main():
         "fed16q_bf16_pipeline": fed16_bf16_pipeline,
         "fed16q_bf16_pipeline_off": fed16_bf16_pipeline_off,
         "fed16q_bf16_guards_off": fed16_bf16_guards_off,
+        "fed16q_bf16_trace_on": fed16_bf16_trace_on,
         "fed256": fed256,
         "fed_streamed": fed_streamed,
         "fault_tolerance": fault_tolerance,
@@ -1693,6 +1756,13 @@ def main():
                         "client_rounds_per_s"
                     ),
                     "bf16_guards_off": fed16_bf16_guards_off.get(
+                        "client_rounds_per_s"
+                    ),
+                    # r15: the same trainer path with QFEDX_TRACE=1 —
+                    # the measured end-to-end cost of enabled tracing
+                    # (compare bf16_trainer_pipeline; ratio in
+                    # bench_details.json trace_overhead_vs_off).
+                    "bf16_trainer_trace_on": fed16_bf16_trace_on.get(
                         "client_rounds_per_s"
                     ),
                 },

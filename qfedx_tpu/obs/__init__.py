@@ -1,10 +1,15 @@
-"""Observability: spans, counters, exporters, HLO census.
+"""Observability: spans, counters, histograms, exporters, live endpoints.
 
 The per-phase window into a federated round (ISSUE r08 tentpole; see
 docs/OBSERVABILITY.md). Host-side phases time themselves with
 ``obs.span``; jitted seams carry ``jax.named_scope`` names into XLA
 profiles; exporters merge spans into metrics.jsonl/summary.json and
-write Perfetto-loadable trace.json files.
+write Perfetto-loadable trace.json files. Since r15 the layer also has
+a LIVE half: bounded log-bucketed histograms (``obs.Histogram`` /
+``obs.histogram``), a /metrics + /healthz endpoint
+(``QFEDX_METRICS_PORT``; obs/server.py), request-scoped trace contexts
+(``obs.trace_context``), and multi-process trace shards + merge
+(``obs.write_trace_shard`` / ``obs.merge_trace_shards``).
 
 Usage::
 
@@ -13,9 +18,12 @@ Usage::
     with obs.span("round.dispatch", round=rnd) as sp:
         params, stats = round_fn(...)
     obs.counter("fuse.ops_in", len(ops))
+    obs.histogram("serve.latency_ms", lat_ms)
     obs.write_chrome_trace(run_dir / "trace.json")
 
-Everything is a no-op unless ``QFEDX_TRACE=1`` (see trace.enabled).
+Spans are a no-op unless ``QFEDX_TRACE=1`` (trace.enabled); the bounded
+instruments also record while a live /metrics endpoint is up
+(trace.metrics_enabled).
 """
 
 from qfedx_tpu.obs.export import (
@@ -26,26 +34,41 @@ from qfedx_tpu.obs.export import (
     snapshot,
     write_chrome_trace,
 )
+from qfedx_tpu.obs.histo import Histogram
 from qfedx_tpu.obs.hlo import count_state_ops, module_counts
+from qfedx_tpu.obs.merge import (
+    find_shards,
+    merge_trace_shards,
+    shard_path,
+    write_trace_shard,
+)
 from qfedx_tpu.obs.trace import (
     Span,
     counter,
     enabled,
     gauge,
+    histogram,
+    metrics_enabled,
     record_device_memory,
     registry,
     reset,
     span,
+    trace_context,
     xla_annotations_enabled,
 )
 
 __all__ = [
+    "Histogram",
     "Span",
     "chrome_trace_events",
     "count_state_ops",
     "counter",
     "enabled",
+    "find_shards",
     "gauge",
+    "histogram",
+    "merge_trace_shards",
+    "metrics_enabled",
     "module_counts",
     "percentile",
     "phase_rollup",
@@ -53,8 +76,11 @@ __all__ = [
     "record_device_memory",
     "registry",
     "reset",
+    "shard_path",
     "snapshot",
     "span",
+    "trace_context",
     "write_chrome_trace",
+    "write_trace_shard",
     "xla_annotations_enabled",
 ]

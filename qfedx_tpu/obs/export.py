@@ -19,14 +19,19 @@ from __future__ import annotations
 import json
 from pathlib import Path
 
+from qfedx_tpu.obs.histo import Histogram
 from qfedx_tpu.obs.trace import Span, registry
 
 
 def percentile(sorted_vals: list[float], q: float) -> float:
     """Nearest-rank percentile of an already-sorted list — the ONE
-    quantile definition (phase rollups, the serve CLI summary and the
-    bench serving rows all report through this, so their p50/p95 can
-    never drift apart on index math)."""
+    quantile DEFINITION. Since r15 the production reporters (phase
+    rollup, serve CLI summary, bench serving rows) read quantiles from
+    bounded ``obs.Histogram``s, whose ``percentile`` applies THIS rank
+    rule to bucket counts — so histogram quantiles land within one
+    bucket-width of this function's exact answer (pinned in
+    tests/test_obs.py), and exact/approx can never drift on index
+    math."""
     if not sorted_vals:
         return 0.0
     idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
@@ -36,23 +41,39 @@ def percentile(sorted_vals: list[float], q: float) -> float:
 def phase_rollup(spans: list[Span] | None = None) -> dict[str, dict]:
     """Aggregate spans by name → {count, total_s, p50_s, p95_s,
     compile_s}, ordered by total_s descending (the expensive phase reads
-    first in summary.json)."""
-    spans = registry().spans if spans is None else spans
-    by_name: dict[str, list[Span]] = {}
-    for sp in spans:
-        by_name.setdefault(sp.name, []).append(sp)
+    first in summary.json).
+
+    With no argument this reads the registry's per-span-name duration
+    HISTOGRAMS (bounded memory, maintained as spans close — r15), not
+    the span list: quantiles are bucket-resolution (within one
+    bucket-width of exact, always <= exact — lower-edge nearest-rank,
+    obs/histo.py) while count/total/compile stay exact sums. An
+    explicit span list takes the same path through ephemeral
+    histograms, so the two calls cannot disagree on definitions."""
+    if spans is None:
+        histos, compile_by_name = registry().span_rollup_source()
+    else:
+        histos = {}
+        compile_by_name = {}
+        for sp in spans:
+            h = histos.get(sp.name)
+            if h is None:
+                h = histos[sp.name] = Histogram()
+            h.record(sp.duration)
+            if sp.compile_s > 0:
+                compile_by_name[sp.name] = (
+                    compile_by_name.get(sp.name, 0.0) + sp.compile_s
+                )
     rows = {}
-    for name, group in by_name.items():
-        durs = sorted(sp.duration for sp in group)
+    for name, h in histos.items():
         rows[name] = {
-            "count": len(group),
-            "total_s": round(sum(durs), 6),
-            "p50_s": round(percentile(durs, 0.50), 6),
-            "p95_s": round(percentile(durs, 0.95), 6),
+            "count": h.count,
+            "total_s": round(h.sum, 6),
+            "p50_s": round(h.percentile(0.50), 6),
+            "p95_s": round(h.percentile(0.95), 6),
         }
-        compile_s = sum(sp.compile_s for sp in group)
-        if compile_s > 0:
-            rows[name]["compile_s"] = round(compile_s, 6)
+        if compile_by_name.get(name, 0.0) > 0:
+            rows[name]["compile_s"] = round(compile_by_name[name], 6)
     return dict(sorted(rows.items(), key=lambda kv: -kv[1]["total_s"]))
 
 
@@ -82,6 +103,9 @@ def snapshot() -> dict:
         ],
         "counters": dict(reg.counters),
         "gauges": dict(reg.gauges),
+        "histograms": {
+            name: h.snapshot() for name, h in reg.histos.items()
+        },
     }
 
 

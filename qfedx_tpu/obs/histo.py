@@ -1,0 +1,163 @@
+"""Bounded log-bucketed histograms — the fixed-memory quantile instrument.
+
+Why this exists: before r15 every latency quantile in the repo was
+computed by appending to an unbounded Python list and sorting it at
+report time (the serve CLI summary, bench.py's serving rows, the
+phase rollup's per-span duration lists). That is fine for a 30-round
+training run and fatal for the long-lived processes the repo now runs —
+a `qfedx serve` loop under sustained traffic grows its latency list
+without bound, and a live ``/metrics`` endpoint (obs/server.py) cannot
+render "the current p95" from a list it would have to sort per scrape.
+
+``Histogram`` replaces the lists:
+
+- **Fixed memory.** Values land in logarithmically spaced buckets —
+  ``BUCKETS_PER_DECADE`` per power of ten from ``LO`` across
+  ``DECADES`` decades (~2.3 KB of counts), plus an underflow and an
+  overflow bucket. Recording is O(1); no allocation after construction.
+- **Bounded quantile error.** ``percentile(q)`` uses the SAME
+  nearest-rank definition as ``obs.percentile`` (export.py — the one
+  quantile definition) over bucket counts and returns the LOWER edge of
+  the bucket holding that rank. Because the exact rank value lies in
+  that same bucket, the reported quantile is within ONE bucket-width of
+  the exact one (pinned in tests/test_obs.py), and never ABOVE it — so
+  single-sample rollups keep ``p50 <= total``.
+- **Merge-able.** ``merge`` adds bucket counts, so per-thread /
+  per-process / per-wave histograms combine exactly (the multi-process
+  trace-merge sibling for scalars).
+- **Thread-safe.** ``record`` / ``percentile`` / ``merge`` take an
+  internal lock — uploader, dispatcher and telemetry threads share one
+  instrument without losing counts (the r15 hardening hammer test).
+
+Units are the caller's: the registry's span histograms record seconds,
+``serve.latency_ms`` records milliseconds — the bucket grid spans 12
+decades from 1e-6, which covers both comfortably.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+# Bucket grid: 24 buckets per decade => bucket edges grow by 10^(1/24)
+# (~10% per bucket), i.e. a quantile is reported with <= ~10% relative
+# error. 12 decades from 1e-6 cover 1 µs..1e6 s in seconds or 1 ns..1e3 s
+# in milliseconds — every latency this repo measures, with headroom.
+LO = 1e-6
+BUCKETS_PER_DECADE = 24
+DECADES = 12
+NUM_BUCKETS = BUCKETS_PER_DECADE * DECADES
+
+
+def bucket_edge(i: int) -> float:
+    """Upper edge of bucket ``i`` (lower edge of bucket ``i + 1``)."""
+    return LO * 10.0 ** (i / BUCKETS_PER_DECADE)
+
+
+class Histogram:
+    """Fixed-memory log-bucketed value distribution.
+
+    ``counts[0]`` is the underflow bucket (values < LO, lower edge 0);
+    ``counts[1 + i]`` holds values in [edge(i), edge(i + 1)) for
+    i < NUM_BUCKETS; ``counts[-1]`` is the overflow bucket (values >=
+    edge(NUM_BUCKETS), lower edge = that edge).
+    """
+
+    __slots__ = ("_counts", "count", "sum", "_lock")
+
+    def __init__(self):
+        self._counts = [0] * (NUM_BUCKETS + 2)
+        self.count = 0
+        self.sum = 0.0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _index(value: float) -> int:
+        if not value >= LO:  # also catches NaN: land it in underflow
+            return 0
+        i = int(math.log10(value / LO) * BUCKETS_PER_DECADE)
+        return min(i, NUM_BUCKETS) + 1
+
+    @staticmethod
+    def bucket_bounds(value: float) -> tuple[float, float]:
+        """[lower, upper) edges of the bucket ``value`` lands in — the
+        "one bucket-width" the quantile-error pin is stated against."""
+        idx = Histogram._index(value)
+        if idx == 0:
+            return (0.0, LO)
+        if idx == NUM_BUCKETS + 1:
+            return (bucket_edge(NUM_BUCKETS), math.inf)
+        return (bucket_edge(idx - 1), bucket_edge(idx))
+
+    def record(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._counts[self._index(value)] += 1
+            self.count += 1
+            self.sum += value
+
+    def percentile(self, q: float) -> float:
+        """Nearest-rank quantile (the obs.percentile definition applied
+        to bucket counts): lower edge of the bucket holding rank
+        ``round(q * (count - 1))``. 0.0 when empty."""
+        with self._lock:
+            return self.percentile_unlocked(q)
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold ``other``'s counts into this histogram (exact — bucket
+        grids are module constants, so two histograms always align)."""
+        with other._lock:
+            counts = list(other._counts)
+            cnt, s = other.count, other.sum
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self.count += cnt
+            self.sum += s
+        return self
+
+    def nonzero_buckets(self) -> list[tuple[float, int]]:
+        """``[(upper_edge, cumulative_count), ...]`` over buckets with
+        occupants — the Prometheus ``le`` rendering (obs/server.py).
+        The overflow bucket is omitted; its mass shows in ``+Inf``
+        (== ``count``)."""
+        out: list[tuple[float, int]] = []
+        with self._lock:
+            cum = 0
+            for idx in range(NUM_BUCKETS + 1):
+                c = self._counts[idx]
+                if c:
+                    cum += c
+                    out.append((bucket_edge(idx) if idx else LO, cum))
+        return out
+
+    def snapshot(self) -> dict:
+        """Plain-data view for exporters (obs.snapshot)."""
+        with self._lock:
+            return {
+                "count": self.count,
+                "sum": round(self.sum, 9),
+                "p50": self.percentile_unlocked(0.50),
+                "p95": self.percentile_unlocked(0.95),
+            }
+
+    # percentile() takes the lock; snapshot() already holds it. The lock
+    # is not reentrant (plain Lock — cheaper on the record hot path), so
+    # snapshot uses this unlocked twin.
+    def percentile_unlocked(self, q: float) -> float:
+        if self.count == 0:
+            return 0.0
+        rank = min(self.count - 1, max(0, int(round(q * (self.count - 1)))))
+        seen = 0
+        for idx, c in enumerate(self._counts):
+            seen += c
+            if seen > rank:
+                if idx == 0:
+                    return 0.0
+                return bucket_edge(idx - 1) if idx <= NUM_BUCKETS else (
+                    bucket_edge(NUM_BUCKETS)
+                )
+        return 0.0
+
+    def __repr__(self) -> str:  # debugging aid only
+        return f"Histogram(count={self.count}, sum={self.sum:.6g})"

@@ -1,0 +1,133 @@
+"""Multi-process trace shards + merger — one timeline for a gloo/pod run.
+
+The obs registry is process-local by design (obs/trace.py): on a
+2-process gloo run (tests/test_distributed.py) or a multi-host pod,
+each process records its own spans against its own ``perf_counter``
+origin, and before r15 only process 0's registry ever reached a
+``trace.json`` — the cross-process picture (does wave dispatch on
+process 1 overlap the psum wait on process 0?) was unrecordable.
+
+Two halves:
+
+- ``write_trace_shard(dir)`` — EVERY process writes its registry as
+  ``trace.<process_index>.json`` (keyed by ``jax.process_index()``),
+  a normal Chrome trace file (loadable alone) plus a ``qfedx_shard``
+  stanza carrying the process index and ``origin_unix`` — the wall
+  clock instant of the registry's monotonic origin, the only anchor a
+  merger can rebase different processes' monotonic clocks onto.
+- ``merge_trace_shards(dir)`` — aligns the shards into ONE
+  Chrome/Perfetto file: each shard's events shift by its origin's
+  offset from the earliest shard's, and land in their own process lane
+  (Chrome ``pid`` = process index, named ``qfedx process <i>``), with
+  thread tracks preserved inside each lane. Nesting stays monotonic
+  per lane because a uniform shift preserves per-shard ordering.
+
+Honest caveat: alignment rides ``time.time()`` — exact on one machine
+(the gloo harness), NTP-accurate (~ms) across hosts. That bounds
+cross-LANE skew only; intervals within a lane are monotonic-clock
+exact either way.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+from qfedx_tpu.obs.export import chrome_trace_events
+from qfedx_tpu.obs.trace import registry
+
+_SHARD_RE = re.compile(r"^trace\.(\d+)\.json$")
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — shard writing must not need a backend
+        return 0
+
+
+def shard_path(trace_dir: str | Path, process_index: int | None = None) -> Path:
+    idx = _process_index() if process_index is None else int(process_index)
+    return Path(trace_dir) / f"trace.{idx}.json"
+
+
+def write_trace_shard(
+    trace_dir: str | Path, process_index: int | None = None
+) -> Path:
+    """Write THIS process's registry as its trace shard. Unlike every
+    other ``run/`` artifact this is NOT primary-gated — a shard per
+    process is the point; the merger reunites them."""
+    reg = registry()
+    path = shard_path(trace_dir, process_index)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    idx = _process_index() if process_index is None else int(process_index)
+    path.write_text(
+        json.dumps(
+            {
+                "traceEvents": chrome_trace_events(),
+                "displayTimeUnit": "ms",
+                "qfedx_shard": {
+                    "process_index": idx,
+                    "origin_unix": reg.origin_unix,
+                },
+            }
+        )
+    )
+    return path
+
+
+def find_shards(trace_dir: str | Path) -> list[Path]:
+    """The ``trace.<i>.json`` shards under ``trace_dir``, ordered by
+    process index."""
+    out = []
+    for p in Path(trace_dir).iterdir():
+        m = _SHARD_RE.match(p.name)
+        if m:
+            out.append((int(m.group(1)), p))
+    return [p for _i, p in sorted(out)]
+
+
+def merge_trace_shards(
+    trace_dir: str | Path, out_path: str | Path | None = None
+) -> dict:
+    """Merge every shard under ``trace_dir`` into one Chrome trace dict
+    (written to ``out_path`` when given). Raises FileNotFoundError when
+    no shard exists — a silent empty merge would read as a healthy but
+    idle run."""
+    shards = []
+    for path in find_shards(trace_dir):
+        obj = json.loads(path.read_text())
+        meta = obj.get("qfedx_shard") or {}
+        shards.append(
+            (
+                int(meta.get("process_index", len(shards))),
+                float(meta.get("origin_unix", 0.0)),
+                obj.get("traceEvents", []),
+            )
+        )
+    if not shards:
+        raise FileNotFoundError(
+            f"no trace.<i>.json shards under {trace_dir} — did each "
+            "process call obs.write_trace_shard?"
+        )
+    t0 = min(origin for _i, origin, _e in shards)
+    merged: list[dict] = []
+    for idx, origin, events in shards:
+        offset_us = (origin - t0) * 1e6
+        for e in events:
+            e = dict(e)
+            e["pid"] = idx
+            if e.get("name") == "process_name" and e.get("ph") == "M":
+                e["args"] = {"name": f"qfedx process {idx}"}
+            if "ts" in e:
+                e["ts"] = round(e["ts"] + offset_us, 3)
+            merged.append(e)
+    out = {"traceEvents": merged, "displayTimeUnit": "ms"}
+    if out_path is not None:
+        out_path = Path(out_path)
+        out_path.parent.mkdir(parents=True, exist_ok=True)
+        out_path.write_text(json.dumps(out))
+    return out

@@ -1,0 +1,261 @@
+"""The live telemetry endpoint: /metrics + /healthz on a daemon thread.
+
+Before r15 the obs registry was post-hoc only — spans and counters
+rolled up into ``summary.json`` / ``trace.json`` at ``finish()``, so the
+long-lived processes the repo now runs (the ``qfedx serve`` loop, the
+streamed trainer) were black boxes *while they ran*. This module is the
+live half: a stdlib ``http.server`` on a daemon thread (no new
+dependencies — the container's import surface is pinned) rendering the
+process-local registry on demand.
+
+- ``GET /metrics`` — Prometheus text exposition (0.0.4): every counter,
+  gauge and bounded histogram (obs/histo.py) in the registry, names
+  sanitized ``serve.requests_served`` → ``qfedx_serve_requests_served``;
+  span-duration histograms render with a ``_seconds`` suffix. Histogram
+  buckets are cumulative ``le`` rows over occupied buckets.
+- ``GET /healthz`` — liveness JSON: per-component health sources
+  (``set_health_source``) report last-completed round / last-flush age
+  for the trainer and queue depth / shed count for the serving stack; a
+  raising source degrades status instead of 500ing the probe.
+
+Lifecycle: **default off.** ``maybe_start()`` reads the
+``QFEDX_METRICS_PORT`` pin (0/unset = off — no thread, no socket, no
+effect on compiled programs; the default-off invariance is pinned in
+tests) and is idempotent — the streamed trainer, the serve engine and
+the micro-batcher all call it, the first caller wins, everyone shares
+ONE server per process. While a server runs, the bounded instruments
+(counters/gauges/histograms) record even with QFEDX_TRACE off
+(``trace.metrics_enabled``); spans — unbounded state — still require
+the pin. ``stop_server()`` is for tests and embedders; in production
+the daemon thread dies with the process.
+
+Every scrape records an ``obs.http`` span (path + status meta) when
+tracing is on — the telemetry is itself observable.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from qfedx_tpu.obs import trace
+from qfedx_tpu.utils import pins
+
+_lock = threading.Lock()
+_server: "TelemetryServer | None" = None
+_health_sources: dict[str, Callable[[], dict]] = {}
+
+
+def metrics_port() -> int:
+    """The QFEDX_METRICS_PORT pin: 0/'off'/unset = no server (default),
+    else the localhost port /metrics + /healthz bind to."""
+    return pins.port_pin("QFEDX_METRICS_PORT", 0)
+
+
+# -- rendering ----------------------------------------------------------------
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str, suffix: str = "") -> str:
+    return "qfedx_" + _NAME_RE.sub("_", name) + suffix
+
+
+def _fmt(v: float) -> str:
+    return repr(round(v, 9)) if isinstance(v, float) else str(v)
+
+
+def render_prometheus() -> str:
+    """The registry as Prometheus 0.0.4 text. Pure function of the
+    registry — callable without a server (tests, ad-hoc dumps)."""
+    counters, gauges, histos, span_histos = trace.registry().instruments()
+    lines: list[str] = []
+    for name, val in sorted(counters.items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {_fmt(val)}")
+    for name, val in sorted(gauges.items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_fmt(val)}")
+    rendered = [(n, h, "") for n, h in histos.items()]
+    rendered += [(n, h, "_seconds") for n, h in span_histos.items()]
+    # Sort on (name, suffix) only: equal names (a value histogram
+    # colliding with a span name) must never make sorted() compare the
+    # Histogram objects themselves.
+    for name, h, suffix in sorted(rendered, key=lambda t: (t[0], t[2])):
+        pn = _prom_name(name, suffix)
+        lines.append(f"# TYPE {pn} histogram")
+        for le, cum in h.nonzero_buckets():
+            lines.append(f'{pn}_bucket{{le="{_fmt(le)}"}} {cum}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {h.count}')
+        lines.append(f"{pn}_sum {_fmt(h.sum)}")
+        lines.append(f"{pn}_count {h.count}")
+    return "\n".join(lines) + "\n"
+
+
+def health_payload() -> dict:
+    """The /healthz body: per-component sources merged under one status.
+    A raising source marks the payload degraded but never kills the
+    probe — an orchestrator must be able to read a sick process."""
+    from qfedx_tpu.run.metrics import METRICS_SCHEMA_VERSION
+
+    with _lock:
+        sources = dict(_health_sources)
+        srv = _server
+    out: dict = {
+        "status": "ok",
+        "trace_enabled": trace.enabled(),
+        "metrics_schema": METRICS_SCHEMA_VERSION,
+    }
+    if srv is not None:
+        out["uptime_s"] = round(time.monotonic() - srv.started_mono, 3)
+    comps = {}
+    for name, fn in sorted(sources.items()):
+        try:
+            comps[name] = fn()
+        except Exception as exc:  # noqa: BLE001 — a sick source degrades, never 500s
+            comps[name] = {"error": f"{type(exc).__name__}: {exc}"}
+            out["status"] = "degraded"
+    out["components"] = comps
+    return out
+
+
+def set_health_source(name: str, fn: Callable[[], dict]) -> None:
+    """Register (or replace) a component's /healthz contributor — a
+    zero-arg callable returning a JSON-able dict. Components unregister
+    with ``clear_health_source`` on close so a dead batcher's stats
+    don't read as live."""
+    with _lock:
+        _health_sources[name] = fn
+
+
+def clear_health_source(name: str, only_if: Callable | None = None) -> None:
+    """Unregister ``name``. With ``only_if``, pop only when the current
+    registration IS that callable — a closing component must not evict
+    a newer component that took the name over (latest wins on
+    ``set_health_source``; the loser's close is then a no-op)."""
+    with _lock:
+        if only_if is None or _health_sources.get(name) is only_if:
+            _health_sources.pop(name, None)
+
+
+# -- the server ---------------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # http.server logs every request to stderr by default — that is a
+    # bare print by another name (docs/OBSERVABILITY.md "No bare
+    # print()"); the obs.http span/counter below is the telemetry.
+    def log_message(self, *_a):  # noqa: D102
+        return None
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        path = self.path.split("?", 1)[0]
+        # The span closes BEFORE the response bytes go out: a client
+        # that has received its reply must be able to see the request's
+        # span in the registry (the write itself is µs of socket work).
+        with trace.span("obs.http", path=path) as sp:
+            if path == "/metrics":
+                body = render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                status = 200
+            elif path == "/healthz":
+                payload = health_payload()
+                body = (json.dumps(payload) + "\n").encode()
+                ctype = "application/json"
+                status = 200 if payload["status"] == "ok" else 503
+            else:
+                body = b"not found: /metrics and /healthz only\n"
+                ctype = "text/plain"
+                status = 404
+            sp.set(status=status)
+            trace.counter("obs.http_requests")
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class TelemetryServer:
+    """One process-wide /metrics + /healthz server on a daemon thread."""
+
+    def __init__(self, port: int):
+        # localhost only: telemetry is an operator loopback/sidecar
+        # surface, not a public listener.
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])
+        self.started_mono = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="qfedx-metrics",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def start_server(port: int) -> TelemetryServer:
+    """Start (or return) THE process telemetry server. Idempotent: a
+    second caller gets the running instance regardless of port — one
+    process, one scrape surface. Flips the live-metrics gate so the
+    bounded instruments record while the endpoint is up."""
+    global _server
+    with _lock:
+        if _server is None:
+            _server = TelemetryServer(port)
+            trace.set_live_metrics(True)
+        return _server
+
+
+def maybe_start() -> TelemetryServer | None:
+    """Start the endpoint iff QFEDX_METRICS_PORT says so (default off —
+    returns None, starts no thread). The one call every long-lived
+    component makes at startup.
+
+    A bind failure DEGRADES (warn, return None) instead of raising:
+    two processes sharing one exported pin — the gloo pair, or trainer
+    + serve on one host — must not let the loser's missing telemetry
+    kill its actual work. ``start_server`` stays loud for direct
+    callers (tests bind ephemeral ports and want errors)."""
+    port = metrics_port()
+    if port == 0:
+        return None
+    try:
+        return start_server(port)
+    except OSError as exc:
+        import warnings
+
+        warnings.warn(
+            f"QFEDX_METRICS_PORT={port}: telemetry endpoint not started "
+            f"({exc}) — continuing without /metrics",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return None
+
+
+def stop_server() -> None:
+    """Tear the process server down (tests / embedders); re-arms the
+    default-off state and the live-metrics gate."""
+    global _server
+    with _lock:
+        srv, _server = _server, None
+        trace.set_live_metrics(False)
+    if srv is not None:
+        srv.stop()
+
+
+def active_server() -> TelemetryServer | None:
+    with _lock:
+        return _server

@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Any
 
+from qfedx_tpu.obs.histo import Histogram
 from qfedx_tpu.utils import pins
 
 
@@ -49,6 +50,27 @@ def enabled() -> bool:
     would silently disable every span, so the shared pin parser rejects
     it loudly."""
     return pins.bool_pin("QFEDX_TRACE", False)
+
+
+# Live telemetry (r15): when an obs/server.py endpoint is running, the
+# BOUNDED instruments (counters, gauges, histograms — fixed memory, what
+# /metrics renders) record even with QFEDX_TRACE off. Spans stay gated
+# on the pin alone: a span list grows without bound, which a long-lived
+# serve loop must opt into, not inherit from exposing a scrape port.
+_live_metrics = False
+
+
+def set_live_metrics(on: bool) -> None:
+    """Flipped by obs.server start/stop — not a user API."""
+    global _live_metrics
+    _live_metrics = bool(on)
+
+
+def metrics_enabled() -> bool:
+    """Should counters/gauges/histograms record? True when QFEDX_TRACE
+    is on OR a live /metrics endpoint is serving (bounded state only —
+    see set_live_metrics)."""
+    return _live_metrics or enabled()
 
 
 def xla_annotations_enabled() -> bool:
@@ -112,13 +134,29 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Registry:
-    """Process-local store of finished spans + counters + gauges."""
+    """Process-local store of finished spans + counters + gauges +
+    histograms. Every mutation happens under ONE lock (the r15
+    thread-safety pin: concurrent uploader/serve/telemetry threads
+    bumping the same counter must lose no increments —
+    tests/test_obs.py hammers this)."""
 
     def __init__(self):
         self.spans: list[Span] = []
         self.counters: dict[str, float] = {}
         self.gauges: dict[str, float] = {}
+        # Explicit value histograms (obs.histogram — serve.latency_ms)
+        # and per-span-name duration histograms in SECONDS, recorded as
+        # spans close: the fixed-memory source phase_rollup and the
+        # /metrics endpoint read quantiles from, instead of sorting the
+        # span list per report (obs/histo.py).
+        self.histos: dict[str, Histogram] = {}
+        self.span_histos: dict[str, Histogram] = {}
+        self.span_compile: dict[str, float] = {}
         self.origin = time.perf_counter()
+        # Wall-clock instant of ``origin``: the cross-process alignment
+        # anchor trace shards carry (obs/merge.py) — perf_counter is
+        # process-local, so a merger needs a shared clock to rebase on.
+        self.origin_unix = time.time()
         self._local = threading.local()
         self._lock = threading.Lock()
 
@@ -128,9 +166,23 @@ class _Registry:
             st = self._local.stack = []
         return st
 
+    def context(self) -> list[dict]:
+        ctx = getattr(self._local, "ctx", None)
+        if ctx is None:
+            ctx = self._local.ctx = []
+        return ctx
+
     def add_span(self, sp: Span) -> None:
         with self._lock:
             self.spans.append(sp)
+            h = self.span_histos.get(sp.name)
+            if h is None:
+                h = self.span_histos[sp.name] = Histogram()
+            h.record(sp.duration)
+            if sp.compile_s > 0:
+                self.span_compile[sp.name] = (
+                    self.span_compile.get(sp.name, 0.0) + sp.compile_s
+                )
 
     def add_counter(self, name: str, inc: float) -> None:
         with self._lock:
@@ -139,6 +191,34 @@ class _Registry:
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self.gauges[name] = value
+
+    def record_histogram(self, name: str, value: float) -> None:
+        with self._lock:
+            h = self.histos.get(name)
+            if h is None:
+                h = self.histos[name] = Histogram()
+        # Histogram.record takes its own lock; recording outside the
+        # registry lock keeps the instrument hot path short.
+        h.record(value)
+
+    def instruments(self) -> tuple[dict, dict, dict, dict]:
+        """Consistent shallow copies of (counters, gauges, histos,
+        span_histos) for renderers — iteration must not race inserts."""
+        with self._lock:
+            return (
+                dict(self.counters),
+                dict(self.gauges),
+                dict(self.histos),
+                dict(self.span_histos),
+            )
+
+    def span_rollup_source(self) -> tuple[dict, dict]:
+        """Consistent shallow copies of (span_histos, span_compile) —
+        what phase_rollup aggregates. The accessor keeps the one-lock
+        invariant inside this class instead of letting exporters reach
+        for ``_lock`` themselves."""
+        with self._lock:
+            return dict(self.span_histos), dict(self.span_compile)
 
 
 _REGISTRY = _Registry()
@@ -158,6 +238,11 @@ def reset() -> None:
 # --- compile-event attribution ------------------------------------------------
 
 _listener_installed = False
+# r15 hardening: _install_listener used to be a bare check-then-set —
+# two threads entering their first span concurrently could BOTH register
+# the jax.monitoring listener, double-counting every compile duration
+# from then on. The lock makes installation exactly-once.
+_listener_lock = threading.Lock()
 
 
 def _on_event_duration(event: str, duration: float, **_kw) -> None:
@@ -181,13 +266,18 @@ def _install_listener() -> None:
     global _listener_installed
     if _listener_installed:
         return
-    _listener_installed = True
-    try:
-        from jax import monitoring
+    with _listener_lock:
+        if _listener_installed:
+            return
+        _listener_installed = True
+        try:
+            from jax import monitoring
 
-        monitoring.register_event_duration_secs_listener(_on_event_duration)
-    except Exception:  # noqa: BLE001 — older jax: spans still work, no attribution
-        pass
+            monitoring.register_event_duration_secs_listener(
+                _on_event_duration
+            )
+        except Exception:  # noqa: BLE001 — older jax: spans work, no attribution
+            pass
 
 
 # --- public API ---------------------------------------------------------------
@@ -212,7 +302,19 @@ class span:
             return _NULL_SPAN
         _install_listener()
         reg = _REGISTRY
-        sp = Span(self._name, dict(self._meta))
+        meta = dict(self._meta)
+        # Request-scoped tracing (r15): merge the thread's open trace
+        # contexts (innermost wins below explicit span meta) so every
+        # span inside `with trace_context(reqs=...)` carries the ids it
+        # served without the callee's signature knowing about them.
+        ctx = reg.context()
+        if ctx:
+            merged: dict = {}
+            for d in ctx:
+                merged.update(d)
+            merged.update(meta)
+            meta = merged
+        sp = Span(self._name, meta)
         stack = reg.stack()
         sp.depth = len(stack)
         sp.parent = stack[-1] if stack else None
@@ -251,16 +353,59 @@ class span:
         return False
 
 
+class trace_context:
+    """``with obs.trace_context(reqs="3,4,5"):`` — attach metadata to
+    EVERY span opened on this thread inside the block (request-scoped
+    tracing, r15). The batcher wraps each engine dispatch in the batch's
+    request ids, so serve.pad/compute/fetch spans carry the ids they
+    served without threading them through call signatures. Explicit
+    span meta wins on key collision; contexts nest (innermost context
+    wins among contexts). No-op when tracing is off."""
+
+    __slots__ = ("_meta", "_pushed")
+
+    def __init__(self, **meta: Any):
+        self._meta = meta
+        self._pushed = False
+
+    def __enter__(self):
+        if enabled():
+            _REGISTRY.context().append(self._meta)
+            self._pushed = True
+        return self
+
+    def __exit__(self, *exc):
+        if self._pushed:
+            ctx = _REGISTRY.context()
+            if ctx and ctx[-1] is self._meta:
+                ctx.pop()
+            elif self._meta in ctx:
+                ctx.remove(self._meta)
+        return False
+
+
 def counter(name: str, inc: float = 1.0) -> None:
-    """Accumulate a process-total counter (no-op when tracing is off)."""
-    if enabled():
+    """Accumulate a process-total counter (no-op when tracing is off
+    and no live /metrics endpoint is running)."""
+    if metrics_enabled():
         _REGISTRY.add_counter(name, float(inc))
 
 
 def gauge(name: str, value: float) -> None:
-    """Record the latest value of a quantity (no-op when tracing is off)."""
-    if enabled():
+    """Record the latest value of a quantity (no-op when tracing is off
+    and no live /metrics endpoint is running)."""
+    if metrics_enabled():
         _REGISTRY.set_gauge(name, float(value))
+
+
+def histogram(name: str, value: float) -> None:
+    """Record one observation into the named bounded histogram
+    (obs/histo.py — fixed memory, merge-able, ~10% quantile error).
+    The registry instrument behind the /metrics bucket rendering and
+    the serve latency quantiles. No-op when tracing is off and no live
+    /metrics endpoint is running."""
+    if metrics_enabled():
+        _REGISTRY.record_histogram(name, float(value))
 
 
 def record_device_memory(prefix: str = "mem") -> dict | None:

@@ -425,7 +425,12 @@ def run_serve(args) -> dict:
 
     in_f = sys.stdin if args.input == "-" else open(args.input)
     out_f = sys.stdout if args.output == "-" else open(args.output, "w")
-    latencies: list[float] = []
+    # Bounded latency distribution (r15): the log-bucketed histogram
+    # replaces the unbounded per-request list — a long-lived serve loop
+    # holds ~2 KB of buckets however much traffic it answers, and its
+    # p50/p95 land within one bucket-width of the exact quantile
+    # (obs/histo.py; pinned in tests/test_obs.py).
+    lat_hist = obs.Histogram()
     window: list = []  # ordered (id, future | error-dict) in-flight pairs
 
     def emit(rid, fut_or_err):
@@ -442,7 +447,9 @@ def run_serve(args) -> dict:
                 # (the batcher's clock stamps both); emit can run long
                 # after completion when the input stream is slow, so
                 # measuring here would fold reader idle time into p50.
-                latencies.append(fut_or_err.done_t - fut_or_err.submit_t)
+                lat_hist.record(
+                    (fut_or_err.done_t - fut_or_err.submit_t) * 1e3
+                )
                 rec = {
                     "id": rid,
                     "pred": res["pred"],
@@ -506,10 +513,18 @@ def run_serve(args) -> dict:
             in_f.close()
         if out_f is not sys.stdout:
             out_f.close()
-    lat = sorted(latencies)
+        # Crash-flush (r15): the trace write lives in the finally so an
+        # unexpected exception (not just EOF/SIGTERM) still leaves a
+        # valid trace of the completed spans on disk.
+        if obs.enabled() and is_primary():
+            trace_path = obs.write_chrome_trace(
+                Path(args.run_dir) / "serve_trace.json"
+            )
+            say(f"[qfedx_tpu] serve trace: {trace_path}")
 
-    def pct(q):  # the shared quantile definition (bench rows use it too)
-        return round(1e3 * obs.percentile(lat, q), 3)
+    def pct(q):  # histogram quantile: the obs.percentile rank rule over
+        # log buckets, within one bucket-width of exact (obs/histo.py)
+        return round(lat_hist.percentile(q), 3)
 
     # "served" counts requests the ENGINE answered (batcher ledger);
     # "responses" counts emitted JSONL lines, which include per-request
@@ -518,16 +533,11 @@ def run_serve(args) -> dict:
     summary = {
         "served": batcher.stats["served"],
         "responses": responses,
-        "p50_ms": pct(0.50) if lat else None,
-        "p95_ms": pct(0.95) if lat else None,
+        "p50_ms": pct(0.50) if lat_hist.count else None,
+        "p95_ms": pct(0.95) if lat_hist.count else None,
         **{k: batcher.stats[k] for k in ("rejected", "shed", "batches")},
     }
     say("[qfedx_tpu] serve summary: " + json.dumps(summary))
-    if obs.enabled() and is_primary():
-        trace_path = obs.write_chrome_trace(
-            Path(args.run_dir) / "serve_trace.json"
-        )
-        say(f"[qfedx_tpu] serve trace: {trace_path}")
     return summary
 
 

@@ -21,6 +21,42 @@ from typing import Any, Mapping
 
 from qfedx_tpu.utils.host import is_primary
 
+# The metrics.jsonl record contract (r15): every row carries
+# ``"schema": METRICS_SCHEMA_VERSION`` so consumers — the live /healthz
+# endpoint (obs/server.py), pandas loaders, the chaos tests' ledger
+# reconciliation — can detect a field-name change instead of silently
+# misreading it. Bump this when a REQUIRED field is renamed/retyped;
+# optional fields (accuracy, epsilon, aggregator, phases, ...) may come
+# and go within a version.
+METRICS_SCHEMA_VERSION = 1
+
+# Required fields (name -> type predicate) of a round row at schema 1.
+_REQUIRED_FIELDS: dict[str, Any] = {
+    "schema": lambda v: v == METRICS_SCHEMA_VERSION,
+    "round": lambda v: isinstance(v, int) and v >= 1,
+    "ts": lambda v: isinstance(v, (int, float)),
+}
+
+
+def validate_metrics_record(rec: Mapping[str, Any]) -> dict:
+    """Validate one parsed metrics.jsonl record against the schema;
+    returns the record, raises ``ValueError`` naming the offending
+    field. The round-trip test (tests/test_run_io.py) runs every
+    logged row back through this, so the file and the live endpoint
+    can never silently disagree on field names."""
+    for name, ok in _REQUIRED_FIELDS.items():
+        if name not in rec:
+            raise ValueError(
+                f"metrics record missing required field {name!r} "
+                f"(schema {METRICS_SCHEMA_VERSION}): {dict(rec)!r}"
+            )
+        if not ok(rec[name]):
+            raise ValueError(
+                f"metrics record field {name!r} = {rec[name]!r} invalid "
+                f"at schema {METRICS_SCHEMA_VERSION}"
+            )
+    return dict(rec)
+
 
 def _jsonable(x: Any) -> Any:
     if dataclasses.is_dataclass(x) and not isinstance(x, type):
@@ -99,6 +135,7 @@ class MetricsLogger:
             return
         rec = dict(_jsonable(record))
         rec.setdefault("ts", time.time())
+        rec.setdefault("schema", METRICS_SCHEMA_VERSION)
         self._fh.write(json.dumps(rec) + "\n")
         self._fh.flush()
         os.fsync(self._fh.fileno())
@@ -171,8 +208,46 @@ class ExperimentRun:
                 }
         (self.dir / "summary.json").write_text(json.dumps(_jsonable(summary), indent=2))
 
+    def flush_partial_observability(self, reason: str) -> None:
+        """Crash-flush (r15 satellite): persist the COMPLETED spans as a
+        valid trace.json plus a partial phase rollup. Before this, both
+        were written only on a clean ``finish()`` — a crash or SIGTERM
+        (which utils/host translates into KeyboardInterrupt) lost the
+        whole observability record of the run that most needs forensics.
+        Spans still open at the crash were never added to the registry,
+        so the flushed trace always parses."""
+        if not is_primary():
+            return
+        from qfedx_tpu import obs
+
+        if not obs.enabled():
+            return
+        try:
+            obs.write_chrome_trace(self.dir / "trace.json")
+            if not (self.dir / "summary.json").exists():
+                partial = {
+                    "partial": True,
+                    "crashed": reason,
+                    "wall_time_s": time.time() - self._t0,
+                    "phase_breakdown": obs.phase_rollup(),
+                }
+                counters = obs.registry().counters
+                if counters:
+                    partial["obs_counters"] = {
+                        k: round(v, 6) for k, v in counters.items()
+                    }
+                (self.dir / "summary.json").write_text(
+                    json.dumps(_jsonable(partial), indent=2)
+                )
+        except Exception:  # noqa: BLE001 — flushing must not mask the crash
+            pass
+
     def __enter__(self):
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, exc_type, exc, tb):
         self.metrics.close()
+        if exc_type is not None:
+            self.flush_partial_observability(
+                getattr(exc_type, "__name__", str(exc_type))
+            )

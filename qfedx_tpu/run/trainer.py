@@ -987,6 +987,34 @@ def train_federated_streamed(
     # whole lines), no silently-lost progress. The install/restore pair
     # is shared with `qfedx serve` (utils/host — r14).
     sigterm_token = install_sigterm_interrupt()
+    # Live telemetry (r15): with QFEDX_METRICS_PORT set, /metrics and
+    # /healthz serve from a daemon thread for the whole run (default
+    # off — maybe_start returns None, no thread, no program change).
+    # The trainer's health source reports the liveness an orchestrator
+    # probes: last COMPLETED round and the age of the last metrics
+    # flush — a wedged wave shows as a growing flush age long before
+    # any log line would.
+    from qfedx_tpu.obs import server as obs_server
+
+    obs_server.maybe_start()
+    _beat = {
+        "last_completed_round": start_round,
+        "rounds_total": num_rounds,
+        "last_flush_t": time.monotonic(),
+    }
+    obs_server.set_health_source(
+        "trainer",
+        lambda: {
+            "last_completed_round": _beat["last_completed_round"],
+            "rounds_total": _beat["rounds_total"],
+            "last_flush_age_s": round(
+                time.monotonic() - _beat["last_flush_t"], 3
+            ),
+            "cohort": cohort_size,
+            "waves": num_waves,
+            "stale_buffered": len(pending_late),
+        },
+    )
     last_done, last_params = start_round, params
     try:
         for rnd in range(start_round, num_rounds):
@@ -1404,6 +1432,13 @@ def train_federated_streamed(
                     metrics["mem_bytes_in_use"] = mem["bytes_in_use"]
             if on_round_end is not None:
                 on_round_end(rnd, metrics)
+            # Heartbeat AFTER the metrics row flushed: /healthz's
+            # last_flush_age_s measures the ledger's staleness, not the
+            # loop's.
+            _beat["last_completed_round"] = rnd + 1
+            _beat["last_flush_t"] = time.monotonic()
+            obs.gauge("fed.last_completed_round", rnd + 1)
+            obs.histogram("round.time_s", dt)
 
             last_done, last_params = rnd + 1, params
     except (KeyboardInterrupt, SystemExit):
@@ -1428,6 +1463,7 @@ def train_federated_streamed(
                 pass
         raise
     finally:
+        obs_server.clear_health_source("trainer")
         for p in pending_late:
             try:
                 p["stream"].close()

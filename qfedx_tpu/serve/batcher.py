@@ -28,8 +28,17 @@ with the same discipline the r09–r13 trainer loop earned the hard way:
   pending futures with ``ShuttingDown``.
 
 Spans: ``serve.queue`` times the dispatcher's wait-for-trigger phase;
-pad/compute/fetch happen inside ``engine.infer``. The
-``serve.queue_depth`` gauge samples pending depth at every admission.
+pad/compute/fetch happen inside ``engine.infer`` — all of them carry
+the REQUEST ids they served (``reqs`` meta via ``obs.trace_context``,
+r15), so a request's latency decomposes across queue/pad/compute/fetch
+in trace.json instead of only batch-aggregated. The
+``serve.queue_depth`` gauge samples pending depth at every admission;
+the ``serve.latency_ms`` bounded histogram records every answered
+request's submit→answer latency (the /metrics quantile source —
+obs/histo.py). ``start()`` brings up the live /metrics + /healthz
+endpoint when ``QFEDX_METRICS_PORT`` is set (obs/server.py) and
+registers this batcher's ledger as the ``serve`` health source;
+``close()`` unregisters it.
 """
 
 from __future__ import annotations
@@ -42,6 +51,7 @@ from typing import Any
 import numpy as np
 
 from qfedx_tpu import obs
+from qfedx_tpu.obs import server as obs_server
 from qfedx_tpu.utils import faults
 
 
@@ -115,17 +125,37 @@ class MicroBatcher:
             "served": 0, "rejected": 0, "shed": 0, "batches": 0,
             "deadline_flushes": 0, "full_flushes": 0,
         }
+        self._health_fn = None  # registered by start(); identity-matched on close
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "MicroBatcher":
         if self._thread is not None:
             raise RuntimeError("batcher already started")
+        # Live telemetry (r15): default off — maybe_start returns None
+        # unless QFEDX_METRICS_PORT is set. The health source exposes
+        # the ledger a /healthz probe needs to call the loop live.
+        obs_server.maybe_start()
+        # One stable callable per batcher: bound-method attribute access
+        # creates a fresh object each time, and close()'s only_if match
+        # is by identity.
+        self._health_fn = self._health
+        obs_server.set_health_source("serve", self._health_fn)
         self._thread = threading.Thread(
             target=self._loop, name="qfedx-serve-batcher", daemon=True
         )
         self._thread.start()
         return self
+
+    def _health(self) -> dict:
+        with self._cond:
+            return {
+                "queue_depth": len(self._pending),
+                "closed": self._closed,
+                "engine_warm": bool(getattr(self.engine, "_warm", False)),
+                "buckets": list(self.config.buckets),
+                **dict(self.stats),
+            }
 
     def close(self, drain: bool = True, timeout: float | None = None):
         """Stop admission; drain (answer) or fail the queued requests;
@@ -139,6 +169,12 @@ class MicroBatcher:
             if self._thread.is_alive():
                 raise TimeoutError("dispatcher did not drain in time")
             self._thread = None
+        # Unregister AFTER the drain (probes see the closing ledger to
+        # the end) and only if the registration is still OURS — closing
+        # a never-started or superseded batcher must not evict another
+        # batcher's live source.
+        if getattr(self, "_health_fn", None) is not None:
+            obs_server.clear_health_source("serve", only_if=self._health_fn)
 
     def __enter__(self):
         return self.start()
@@ -248,11 +284,25 @@ class MicroBatcher:
                     self._cond.wait(timeout=0.05)
                 if not self._pending and self._closed:
                     return
+            trace_ids = None
             with obs.span("serve.queue") as sp:
                 with self._cond:
                     taken = self._take_locked()
                 if taken is not None:
-                    sp.set(size=len(taken[0]), flush=taken[1])
+                    meta = {"size": len(taken[0]), "flush": taken[1]}
+                    if obs.enabled():
+                        # Request-scoped tracing (r15): the ids this
+                        # flush serves, comma-joined — the SAME string
+                        # the pad/compute/fetch spans carry below (via
+                        # trace_context), so one request's path is
+                        # grep-able across the trace. Built once per
+                        # flush, and only when spans record: the
+                        # disabled path stays join-free.
+                        trace_ids = ",".join(
+                            str(f.seq) for _t, _x, f in taken[0]
+                        )
+                        meta["reqs"] = trace_ids
+                    sp.set(**meta)
             if taken is None:
                 return
             reqs, kind = taken
@@ -271,7 +321,16 @@ class MicroBatcher:
                 continue
             x = np.stack([r[1] for r in reqs])
             try:
-                logits = self.engine.infer(x, seq=batch_seq)
+                # The trace context stamps every span the engine opens
+                # for this batch (serve.pad/compute/fetch) with the
+                # request ids it serves — batcher→engine propagation
+                # without widening infer's signature (r15). trace_ids
+                # was built (once) above only when tracing is on.
+                if trace_ids is not None:
+                    with obs.trace_context(reqs=trace_ids):
+                        logits = self.engine.infer(x, seq=batch_seq)
+                else:
+                    logits = self.engine.infer(x, seq=batch_seq)
             except BaseException as exc:  # noqa: BLE001 — per-request surfacing
                 for _, _, fut in reqs:
                     fut._set(error=exc)
@@ -283,5 +342,12 @@ class MicroBatcher:
                     "probs": post["probs"][i],
                     "pred": int(post["pred"][i]),
                 })
+                # Bounded latency distribution (r15): submit→answer ms
+                # per request into the log-bucketed histogram — what the
+                # live /metrics quantiles and the CLI summary read,
+                # instead of an unbounded sorted list.
+                obs.histogram(
+                    "serve.latency_ms", (fut.done_t - fut.submit_t) * 1e3
+                )
             self.stats["served"] += len(reqs)
             self.stats["batches"] += 1

@@ -174,7 +174,16 @@ class ServeEngine:
         """Compile every bucket shape ahead of traffic (through the
         QFEDX_COMPILE_CACHE path when the CLI enabled it — a restarted
         server re-warms from the persistent cache instead of re-tracing
-        XLA). Returns per-bucket wall + attributed compile seconds."""
+        XLA). Returns per-bucket wall + attributed compile seconds.
+
+        Also the serving stack's telemetry hook: brings up the live
+        /metrics + /healthz endpoint when QFEDX_METRICS_PORT is set
+        (obs/server.py; default off — no thread, no behavior change),
+        so even a batcher-less embedder gets a scrape surface the
+        moment the engine warms."""
+        from qfedx_tpu.obs import server as obs_server
+
+        obs_server.maybe_start()
         per_bucket = {}
         for b in self.config.buckets:
             x = np.zeros((b,) + self.feature_shape, dtype=np.float32)

@@ -94,6 +94,25 @@ def int_list_pin(name: str, default: tuple[int, ...]) -> tuple[int, ...]:
     return out
 
 
+def port_pin(name: str, default: int = 0) -> int:
+    """Resolve a TCP-port pin (QFEDX_METRICS_PORT) loudly: unset →
+    ``default`` (0 = feature off), ``off``/``0`` → 0, digits in
+    [0, 65535] → that port, anything else raises. A port of 0 passed to
+    the server binds an ephemeral port (tests); via the PIN, 0 simply
+    means "no server" — the default-off invariance the telemetry
+    endpoint pins (docs/OBSERVABILITY.md)."""
+    env = os.environ.get(name)
+    if env is None:
+        return default
+    if env.lower() == "off":
+        return 0
+    if not env.isdigit() or int(env) > 65535:
+        raise ValueError(
+            f"{name}={env!r}: expected 'off' or a port in [0, 65535]"
+        )
+    return int(env)
+
+
 def depth_pin(name: str, default: int, on_value: int = 1) -> int:
     """Resolve an integer-depth pin with the on/off grammar as a prefix:
     ``0``/``off`` → 0, ``1``/``on`` → ``on_value``, a bare integer → that

@@ -31,6 +31,13 @@ defense on: every controller derives the same attack input from the
 plan with zero communication (``byzantine_multipliers``), the attacked
 upload is clipped inside the cross-process program, and the defended
 aggregate must match the single-process flat round bit-for-tolerance.
+``trace`` (r15) is ``flat`` under QFEDX_TRACE=1 with EVERY process
+writing its obs registry as a trace shard
+(``obs.write_trace_shard`` → ``trace.<process_index>.json`` in the
+out_path DIRECTORY); the parent merges the shards with
+``obs.merge_trace_shards`` and pins two process lanes with monotonic
+nesting — the multi-process observability the process-local registry
+could never show alone.
 """
 
 import os
@@ -41,6 +48,10 @@ def main() -> None:
     coordinator, nproc, pid, out_path = sys.argv[1:5]
     mode = sys.argv[5] if len(sys.argv) > 5 else "flat"
     os.environ["JAX_PLATFORMS"] = "cpu"
+    if mode == "trace":
+        # Pinned BEFORE any qfedx import: spans must record from the
+        # first host phase on both processes.
+        os.environ["QFEDX_TRACE"] = "1"
     # The parent test env forces 8 virtual devices; this worker must own
     # exactly one device so the mesh spans the PROCESS boundary.
     os.environ.pop("XLA_FLAGS", None)
@@ -220,6 +231,22 @@ def main() -> None:
         scm = globalize(cm, P("clients"))
 
         round_fn = make_fed_round(model, cfg, mesh, num_clients=num_clients)
+        if mode == "trace":
+            from qfedx_tpu import obs
+
+            # The host-phase span pair every traced round records
+            # (round.dispatch encloses the enqueue, round.fetch the
+            # blocking drain) — nested fed.trace.* spans ride inside
+            # the dispatch's trace. Every process records its OWN
+            # registry; every process writes its OWN shard.
+            with obs.span("round.dispatch", round=1):
+                new_params, stats = round_fn(params, scx, scy, scm, key)
+            with obs.span("round.fetch", round=1):
+                jax.block_until_ready((new_params, stats))
+            os.makedirs(out_path, exist_ok=True)
+            obs.write_trace_shard(out_path)
+            print(f"worker {pid} done", flush=True)
+            return
         new_params, stats = round_fn(params, scx, scy, scm, key)
 
     if int(pid) == 0:

@@ -32,3 +32,13 @@ assert len(jax.devices()) == 8, (
     "tests require the 8-device virtual CPU platform; got "
     f"{jax.devices()} — was a backend already initialized before conftest?"
 )
+
+
+def free_port() -> int:
+    """One shared ephemeral-port helper (gloo coordinators, telemetry
+    servers — test_distributed, test_obs, test_serve)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

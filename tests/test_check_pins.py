@@ -85,6 +85,58 @@ def test_fault_taxonomy_covers_every_site_and_kind():
         assert kind in doc["client.byzantine"]
 
 
+# --- the span-taxonomy guard (r15 satellite, same family) --------------------
+
+from benchmarks.check_spans import (  # noqa: E402
+    check as check_spans,
+    documented_spans,
+    source_spans,
+)
+
+
+def test_span_taxonomy_matches_source():
+    assert check_spans() == []
+
+
+def test_span_scanner_sees_the_known_spans():
+    # An empty scan would make the taxonomy check vacuously pass; the
+    # scanner must at least find the spans the subsystems are built on.
+    spans = source_spans()
+    for name in (
+        "round.dispatch", "round.fetch", "serve.compute", "serve.queue",
+        "ingest.h2d", "engine.trace", "checkpoint.async_write",
+        "obs.http",
+    ):
+        assert name in spans, f"scanner lost {name}"
+    assert documented_spans() >= set(spans)
+
+
+def test_span_guard_fires_both_directions(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "from qfedx_tpu import obs\n"
+        'def f():\n'
+        '    with obs.span("made.up_span", round=1):\n'
+        '        pass\n'
+        '    with obs.span("documented.span"):\n'
+        '        pass\n'
+        '    name = "prose.span mentioned in a string"  # ignored\n'
+    )
+    doc = tmp_path / "OBS.md"
+    doc.write_text(
+        "## Span taxonomy\n\n"
+        "| Span | Where | What |\n|---|---|---|\n"
+        "| `documented.span` | mod.py | a test span |\n"
+        "| `stale.span` | nowhere | gone |\n"
+    )
+    problems = check_spans(pkg, doc)
+    assert any("made.up_span" in p for p in problems)
+    assert any("stale.span" in p and "stale" in p for p in problems)
+    assert not any("documented.span" in p for p in problems)
+    assert not any("prose.span" in p for p in problems)
+
+
 def test_fault_guard_fires_both_directions(tmp_path):
     doc = tmp_path / "ROB.md"
     doc.write_text(

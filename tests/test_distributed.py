@@ -13,7 +13,6 @@ scratch (~1–2 min).
 """
 
 import os
-import socket
 import subprocess
 import sys
 
@@ -26,15 +25,13 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _WORKER = os.path.join(_REPO, "tests", "_distributed_worker.py")
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+from conftest import free_port as _free_port  # noqa: E402 — shared helper
 
 
-def _run_workers(out_path: str, mode: str) -> "np.lib.npyio.NpzFile":
+def _run_workers(out_path: str, mode: str):
     """Spawn the 2-process gloo worker pair and return process 0's saved
-    result arrays."""
+    result arrays (or, for ``trace`` mode, the shard directory — each
+    process writes its own ``trace.<i>.json``)."""
     port = _free_port()
     env = {
         k: v
@@ -72,6 +69,8 @@ def _run_workers(out_path: str, mode: str) -> "np.lib.npyio.NpzFile":
     for p, out in zip(procs, outputs):
         assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
     assert os.path.exists(out_path)
+    if mode == "trace":
+        return out_path
     return np.load(out_path)
 
 
@@ -274,6 +273,66 @@ def test_two_process_dropout_spans_process_boundary(tmp_path):
         got["mean_loss"], np.asarray(ref_stats.mean_loss), atol=1e-5
     )
     assert float(got["total_weight"]) == float(ref_stats.total_weight)
+
+
+@pytest.mark.slow
+def test_two_process_trace_shards_merge_into_two_lanes(tmp_path):
+    """r15 multi-process trace merge over the REAL 2-process harness:
+    each gloo worker runs a traced round and writes its registry as
+    ``trace.<process_index>.json``; the merger must produce ONE
+    Chrome/Perfetto file with a lane per process (distinct pids, named
+    tracks) whose intervals stay monotonically nested per lane — the
+    cross-process timeline the process-local registry could never show.
+    The shard/merge unit logic is pinned fast in tests/test_obs.py;
+    this test pins that REAL multi-controller processes produce
+    mergeable shards."""
+    shard_dir = str(tmp_path / "shards")
+    os.makedirs(shard_dir, exist_ok=True)
+    _run_workers(shard_dir, "trace")
+
+    from qfedx_tpu import obs
+
+    shards = obs.find_shards(shard_dir)
+    assert [p.name for p in shards] == ["trace.0.json", "trace.1.json"]
+    merged = obs.merge_trace_shards(
+        shard_dir, out_path=os.path.join(shard_dir, "trace.json")
+    )
+    import json
+
+    on_disk = json.loads(
+        open(os.path.join(shard_dir, "trace.json")).read()
+    )
+    assert on_disk == merged
+    xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    pids = {e["pid"] for e in xs}
+    assert pids == {0, 1}, f"expected one lane per process, got {pids}"
+    lane_names = {
+        e["pid"]: e["args"]["name"]
+        for e in merged["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert lane_names == {0: "qfedx process 0", 1: "qfedx process 1"}
+    for pid in (0, 1):
+        lane = [e for e in xs if e["pid"] == pid]
+        names = {e["name"] for e in lane}
+        # Both processes recorded the host phase pair.
+        assert {"round.dispatch", "round.fetch"} <= names
+        for e in lane:
+            assert e["ts"] >= 0 and e["dur"] >= 0
+        # Monotonic nesting per lane: any two intervals on one thread
+        # track either nest or are disjoint (no partial overlap).
+        by_tid: dict = {}
+        for e in lane:
+            by_tid.setdefault(e["tid"], []).append(e)
+        for evs in by_tid.values():
+            evs = sorted(evs, key=lambda e: (e["ts"], -e["dur"]))
+            for a, b in zip(evs, evs[1:]):
+                a0, a1 = a["ts"], a["ts"] + a["dur"]
+                b0, b1 = b["ts"], b["ts"] + b["dur"]
+                assert b0 >= a0
+                assert b1 <= a1 + 1e-3 or b0 >= a1 - 1e-3, (
+                    f"partial overlap in lane {pid}: {a} vs {b}"
+                )
 
 
 @pytest.mark.slow

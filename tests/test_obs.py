@@ -1,18 +1,26 @@
-"""Observability subsystem: spans, counters, exporters, trainer wiring.
+"""Observability subsystem: spans, counters, exporters, trainer wiring,
+bounded histograms, live endpoints, trace shards (r08 + r15).
 
-Covers the ISSUE r08 acceptance surface that is testable on CPU: the
-QFEDX_TRACE pin (default-off no-op path), span nesting/attribution,
-jax.monitoring compile attribution, the Chrome/Perfetto trace.json
-structure (schema + monotonic, nested intervals), and the trainer's
-per-round ``phases`` metrics + summary ``phase_breakdown`` rollup.
+Covers the r08 acceptance surface testable on CPU (QFEDX_TRACE pin,
+span nesting/attribution, compile attribution, trace.json structure,
+trainer phases/rollup) plus the r15 live half: log-bucketed histogram
+quantile error (within one bucket-width of exact), registry thread
+safety under concurrent writers, the /metrics + /healthz endpoint and
+its default-off invariance, request-scoped trace contexts, the
+multi-process shard merge unit logic, and the crash-flushed partial
+trace.
 """
 
 import json
+import threading
+import urllib.error
+import urllib.request
 
 import numpy as np
 import pytest
 
 from qfedx_tpu import obs
+from qfedx_tpu.obs import server as obs_server
 
 
 @pytest.fixture()
@@ -287,6 +295,432 @@ def test_pipelined_trace_schema_dispatch_overlaps_drain(traced, tmp_path):
         "dispatch_s" in r["phases"] and "fetch_s" in r["phases"]
         for r in rows
     )
+
+
+# --- bounded histograms (r15 tentpole) ---------------------------------------
+
+
+def test_histogram_quantiles_within_one_bucket_of_exact():
+    """The accuracy pin: the histogram's p50/p95 apply obs.percentile's
+    nearest-rank rule to bucket counts and report the LOWER edge of the
+    bucket holding that rank — so |approx - exact| < that bucket's
+    width, and approx <= exact always."""
+    rng = np.random.default_rng(7)
+    for scale, vals in (
+        ("ms", rng.lognormal(1.0, 1.2, 4000)),
+        ("s", rng.uniform(1e-4, 5e-2, 1000)),
+    ):
+        h = obs.Histogram()
+        for v in vals:
+            h.record(v)
+        s = sorted(vals)
+        for q in (0.5, 0.95, 0.99):
+            exact = obs.percentile(s, q)
+            approx = h.percentile(q)
+            lo, hi = obs.Histogram.bucket_bounds(exact)
+            assert lo <= exact < hi
+            assert approx == lo, (
+                f"{scale} q={q}: approx {approx} != lower edge {lo} "
+                f"of exact {exact}'s bucket"
+            )
+            assert approx <= exact < approx + (hi - lo) + 1e-12
+
+
+def test_histogram_count_sum_empty_and_clamps():
+    h = obs.Histogram()
+    assert h.percentile(0.5) == 0.0 and h.count == 0
+    h.record(0.0)        # below LO -> underflow, lower edge 0
+    h.record(1e30)       # beyond the grid -> overflow bucket
+    assert h.count == 2
+    assert h.percentile(0.0) == 0.0
+    assert h.percentile(1.0) > 0.0  # overflow lower edge, not inf/crash
+    assert h.sum == pytest.approx(1e30)
+
+
+def test_histogram_merge_is_exact():
+    rng = np.random.default_rng(1)
+    a_vals = rng.lognormal(0, 1, 500)
+    b_vals = rng.lognormal(2, 0.5, 700)
+    a, b, both = obs.Histogram(), obs.Histogram(), obs.Histogram()
+    for v in a_vals:
+        a.record(v)
+        both.record(v)
+    for v in b_vals:
+        b.record(v)
+        both.record(v)
+    a.merge(b)
+    assert a.count == both.count
+    assert a.sum == pytest.approx(both.sum)
+    for q in (0.1, 0.5, 0.95):
+        assert a.percentile(q) == both.percentile(q)
+
+
+def test_phase_rollup_histogram_quantiles_match_exact_within_bucket(traced):
+    """The rollup now reads bucket-resolution quantiles from the
+    registry's per-span histograms; count/total stay exact and p95
+    stays within one bucket-width of the sorted-span-list answer."""
+    import time as _time
+
+    for i in range(20):
+        with obs.span("work"):
+            _time.sleep(0.0002 * (1 + (i % 5)))
+    durs = sorted(
+        s.duration for s in obs.registry().spans if s.name == "work"
+    )
+    roll = obs.phase_rollup()["work"]
+    assert roll["count"] == 20
+    assert roll["total_s"] == pytest.approx(sum(durs), rel=1e-4)
+    exact95 = obs.percentile(durs, 0.95)
+    lo, hi = obs.Histogram.bucket_bounds(exact95)
+    assert lo - 1e-6 <= roll["p95_s"] <= exact95
+    # Explicit span lists roll up through the SAME definition.
+    assert (
+        obs.phase_rollup(obs.registry().spans)["work"]["p95_s"]
+        == roll["p95_s"]
+    )
+
+
+# --- registry thread safety (r15 hardening satellite) ------------------------
+
+
+def test_registry_hammer_concurrent_writers_lose_nothing(traced):
+    """Uploader/serve/telemetry threads bump the same instruments
+    concurrently; the registry must lose no increments, histogram
+    observations, or spans."""
+    threads_n, per_thread = 8, 2000
+
+    def hammer(tid):
+        for i in range(per_thread):
+            obs.counter("hammer.count")
+            obs.counter("hammer.weighted", 2.0)
+            obs.histogram("hammer.histo", 1.0 + (i % 7))
+            obs.gauge(f"hammer.gauge_{tid}", float(i))
+        with obs.span("hammer.span"):
+            pass
+
+    threads = [
+        threading.Thread(target=hammer, args=(t,)) for t in range(threads_n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    reg = obs.registry()
+    assert reg.counters["hammer.count"] == threads_n * per_thread
+    assert reg.counters["hammer.weighted"] == 2.0 * threads_n * per_thread
+    assert reg.histos["hammer.histo"].count == threads_n * per_thread
+    assert sum(1 for s in reg.spans if s.name == "hammer.span") == threads_n
+    for t in range(threads_n):
+        assert reg.gauges[f"hammer.gauge_{t}"] == float(per_thread - 1)
+
+
+# --- request-scoped trace context (r15 tentpole) -----------------------------
+
+
+def test_trace_context_stamps_nested_spans(traced):
+    with obs.trace_context(reqs="3,4,5"):
+        with obs.span("serve.pad", batch=3) as sp:
+            pass
+        with obs.trace_context(reqs="9"):  # innermost context wins
+            with obs.span("serve.compute"):
+                pass
+    with obs.span("outside"):
+        pass
+    spans = {s.name: s for s in obs.registry().spans}
+    assert spans["serve.pad"].meta == {"reqs": "3,4,5", "batch": 3}
+    assert spans["serve.compute"].meta == {"reqs": "9"}
+    assert "reqs" not in spans["outside"].meta
+    # explicit span meta beats the context on collision
+    with obs.trace_context(reqs="1"):
+        with obs.span("explicit", reqs="override"):
+            pass
+    assert obs.registry().spans[-1].meta["reqs"] == "override"
+
+
+def test_trace_context_noop_when_disabled(monkeypatch):
+    monkeypatch.delenv("QFEDX_TRACE", raising=False)
+    obs.reset()
+    with obs.trace_context(reqs="1,2"):
+        with obs.span("x"):
+            pass
+    assert obs.registry().spans == []
+
+
+# --- live endpoints (r15 tentpole) -------------------------------------------
+
+
+from conftest import free_port as _free_port  # noqa: E402 — shared helper
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return r.status, r.read().decode()
+
+
+@pytest.fixture()
+def telemetry():
+    """An ephemeral-port telemetry server; always torn down."""
+    srv = obs_server.start_server(0)
+    yield srv
+    obs_server.stop_server()
+
+
+def test_metrics_endpoint_renders_registry(traced, telemetry):
+    obs.counter("serve.requests_served", 5)
+    obs.counter("serve.requests_served", 2)
+    obs.gauge("serve.queue_depth", 3)
+    for v in (1.0, 2.0, 4.0):
+        obs.histogram("serve.latency_ms", v)
+    with obs.span("round.dispatch", round=1):
+        pass
+    status, body = _get(telemetry.port, "/metrics")
+    assert status == 200
+    lines = body.splitlines()
+    assert "qfedx_serve_requests_served 7.0" in lines
+    assert "qfedx_serve_queue_depth 3.0" in lines
+    assert "qfedx_serve_latency_ms_count 3" in lines
+    assert 'qfedx_serve_latency_ms_bucket{le="+Inf"} 3' in lines
+    # cumulative le rows are non-decreasing and end at count
+    cums = [
+        int(l.rsplit(" ", 1)[1]) for l in lines
+        if l.startswith("qfedx_serve_latency_ms_bucket")
+    ]
+    assert cums == sorted(cums) and cums[-1] == 3
+    # span-duration histograms render with the _seconds suffix
+    assert any(l.startswith("qfedx_round_dispatch_seconds_count") for l in lines)
+    # the scrape itself recorded an obs.http span
+    assert any(
+        s.name == "obs.http" and s.meta.get("path") == "/metrics"
+        for s in obs.registry().spans
+    )
+
+
+def test_healthz_sources_and_degraded_status(telemetry):
+    obs_server.set_health_source(
+        "trainer", lambda: {"last_completed_round": 4, "rounds_total": 10}
+    )
+    try:
+        status, body = _get(telemetry.port, "/healthz")
+        assert status == 200
+        hz = json.loads(body)
+        assert hz["status"] == "ok"
+        assert hz["components"]["trainer"]["last_completed_round"] == 4
+        from qfedx_tpu.run.metrics import METRICS_SCHEMA_VERSION
+
+        assert hz["metrics_schema"] == METRICS_SCHEMA_VERSION
+
+        def sick():
+            raise RuntimeError("wedged")
+
+        obs_server.set_health_source("serve", sick)
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            _get(telemetry.port, "/healthz")
+        assert exc_info.value.code == 503
+        hz = json.loads(exc_info.value.read())
+        assert hz["status"] == "degraded"
+        assert "wedged" in hz["components"]["serve"]["error"]
+        # a sick source must not take the healthy one down with it
+        assert hz["components"]["trainer"]["last_completed_round"] == 4
+    finally:
+        obs_server.clear_health_source("trainer")
+        obs_server.clear_health_source("serve")
+
+
+def test_unknown_path_404s(telemetry):
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        _get(telemetry.port, "/nope")
+    assert exc_info.value.code == 404
+
+
+def test_live_metrics_gate_without_trace_pin(monkeypatch, telemetry):
+    """While an endpoint is up the BOUNDED instruments record with
+    QFEDX_TRACE off; spans (unbounded) still require the pin."""
+    monkeypatch.delenv("QFEDX_TRACE", raising=False)
+    obs.reset()
+    assert obs.metrics_enabled() and not obs.enabled()
+    obs.counter("live.count")
+    obs.histogram("live.histo", 1.0)
+    with obs.span("live.span"):
+        pass
+    reg = obs.registry()
+    assert reg.counters["live.count"] == 1.0
+    assert reg.histos["live.histo"].count == 1
+    assert reg.spans == []  # spans stay pin-gated
+
+
+def test_metrics_port_default_off_invariance(monkeypatch):
+    """With QFEDX_METRICS_PORT unset, maybe_start is a no-op: no server,
+    no qfedx-metrics thread, instruments stay dark."""
+    monkeypatch.delenv("QFEDX_METRICS_PORT", raising=False)
+    monkeypatch.delenv("QFEDX_TRACE", raising=False)
+    obs.reset()
+    assert obs_server.maybe_start() is None
+    assert obs_server.active_server() is None
+    assert not any(
+        t.name == "qfedx-metrics" for t in threading.enumerate()
+    )
+    obs.counter("dark")
+    assert obs.registry().counters == {}
+
+
+def test_metrics_port_pin_grammar(monkeypatch):
+    from qfedx_tpu.utils.pins import port_pin
+
+    monkeypatch.setenv("QFEDX_METRICS_PORT", "off")
+    assert obs_server.metrics_port() == 0
+    monkeypatch.setenv("QFEDX_METRICS_PORT", "9108")
+    assert obs_server.metrics_port() == 9108
+    for bad in ("fast", "-1", "70000"):
+        monkeypatch.setenv("QFEDX_METRICS_PORT", bad)
+        with pytest.raises(ValueError, match="QFEDX_METRICS_PORT"):
+            port_pin("QFEDX_METRICS_PORT")
+
+
+def test_metrics_name_collision_renders(traced, telemetry):
+    """A value histogram sharing a name with a span must not break the
+    scrape (sorted() once compared the Histogram objects themselves)."""
+    obs.histogram("collide", 1.0)
+    with obs.span("collide"):
+        pass
+    status, body = _get(telemetry.port, "/metrics")
+    assert status == 200
+    assert "qfedx_collide_count 1" in body
+    assert "qfedx_collide_seconds_count 1" in body
+
+
+def test_maybe_start_degrades_on_busy_port(monkeypatch):
+    """Two processes sharing one exported QFEDX_METRICS_PORT (gloo pair,
+    trainer + serve on a host): the loser warns and runs WITHOUT
+    telemetry instead of dying at startup."""
+    import socket as socket_mod
+
+    with socket_mod.socket() as holder:
+        holder.bind(("127.0.0.1", 0))
+        holder.listen(1)
+        port = holder.getsockname()[1]
+        monkeypatch.setenv("QFEDX_METRICS_PORT", str(port))
+        with pytest.warns(RuntimeWarning, match="QFEDX_METRICS_PORT"):
+            assert obs_server.maybe_start() is None
+        assert obs_server.active_server() is None
+
+
+def test_maybe_start_honors_pin_and_is_idempotent(monkeypatch):
+    port = _free_port()
+    monkeypatch.setenv("QFEDX_METRICS_PORT", str(port))
+    try:
+        srv = obs_server.maybe_start()
+        assert srv is not None and srv.port == port
+        assert obs_server.maybe_start() is srv  # one server per process
+        status, _ = _get(port, "/healthz")
+        assert status == 200
+    finally:
+        obs_server.stop_server()
+
+
+# --- trace shards + merge (r15 tentpole; unit half of the gloo pin) ----------
+
+
+def _make_shard(tmp_path, idx, origin_unix, span_names, monkeypatch):
+    monkeypatch.setenv("QFEDX_TRACE", "1")
+    obs.reset()
+    obs.registry().origin_unix = origin_unix
+    outer, *inner = span_names
+    with obs.span(outer, round=1):
+        for name in inner:
+            with obs.span(name):
+                pass
+    return obs.write_trace_shard(tmp_path, process_index=idx)
+
+
+def test_trace_shard_write_and_merge_aligns_lanes(tmp_path, monkeypatch):
+    p0 = _make_shard(
+        tmp_path, 0, 1000.0, ["round.dispatch", "round.fetch"], monkeypatch
+    )
+    p1 = _make_shard(
+        tmp_path, 1, 1001.5, ["round.dispatch", "round.eval"], monkeypatch
+    )
+    assert [p.name for p in (p0, p1)] == ["trace.0.json", "trace.1.json"]
+    assert obs.find_shards(tmp_path) == [p0, p1]
+    # each shard is itself a loadable chrome trace
+    for p in (p0, p1):
+        obj = json.loads(p.read_text())
+        assert obj["traceEvents"] and "qfedx_shard" in obj
+    merged = obs.merge_trace_shards(
+        tmp_path, out_path=tmp_path / "merged.json"
+    )
+    assert json.loads((tmp_path / "merged.json").read_text()) == merged
+    xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert {e["pid"] for e in xs} == {0, 1}
+    lanes = {
+        e["pid"]: e["args"]["name"]
+        for e in merged["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert lanes == {0: "qfedx process 0", 1: "qfedx process 1"}
+    # alignment: shard 1's origin is 1.5 s later -> its events shift
+    # +1.5e6 µs relative to shard 0's lane
+    lane0 = [e for e in xs if e["pid"] == 0]
+    lane1 = [e for e in xs if e["pid"] == 1]
+    assert min(e["ts"] for e in lane1) >= 1.5e6
+    assert min(e["ts"] for e in lane0) < 1.5e6
+    # nesting survives the shift per lane
+    for lane in (lane0, lane1):
+        parent = max(lane, key=lambda e: e["dur"])
+        for e in lane:
+            assert parent["ts"] <= e["ts"]
+            assert e["ts"] + e["dur"] <= parent["ts"] + parent["dur"] + 1e-3
+
+
+def test_merge_without_shards_is_loud(tmp_path):
+    with pytest.raises(FileNotFoundError, match="trace"):
+        obs.merge_trace_shards(tmp_path)
+
+
+# --- crash flush (r15 satellite) ---------------------------------------------
+
+
+def test_killed_run_flushes_partial_trace_and_rollup(traced, tmp_path):
+    """A run killed mid-loop (hook raising — the same unwind SIGTERM's
+    KeyboardInterrupt takes through utils/host) must leave a valid,
+    parseable trace.json of the COMPLETED spans plus a partial phase
+    rollup, instead of losing the whole observability record."""
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+    from qfedx_tpu.run.metrics import ExperimentRun
+    from qfedx_tpu.run.trainer import train_federated
+
+    model = make_vqc_classifier(n_qubits=2, n_layers=1, num_classes=2)
+    rng = np.random.default_rng(0)
+    cx = rng.uniform(0, 1, (4, 8, 2)).astype(np.float32)
+    cy = rng.integers(0, 2, (4, 8)).astype(np.int32)
+    cm = np.ones((4, 8), dtype=np.float32)
+    tx = rng.uniform(0, 1, (16, 2)).astype(np.float32)
+    ty = rng.integers(0, 2, 16).astype(np.int32)
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1)
+
+    def die(r, m):
+        if r >= 1:
+            raise KeyboardInterrupt("SIGTERM")
+
+    with pytest.raises(KeyboardInterrupt):
+        with ExperimentRun(tmp_path, "crash", config=cfg) as run:
+            train_federated(
+                model, cfg, cx, cy, cm, tx, ty, num_rounds=5,
+                on_round_end=die,
+            )
+    xs = _validate_chrome_trace(run.dir / "trace.json")
+    assert any(e["name"] == "round.dispatch" for e in xs)
+    summary = json.loads((run.dir / "summary.json").read_text())
+    assert summary["partial"] is True
+    assert summary["crashed"] == "KeyboardInterrupt"
+    assert summary["phase_breakdown"]["round.dispatch"]["count"] >= 1
+    # a clean finish() would have written the real summary; the partial
+    # one never overwrites it
+    (run.dir / "summary.json").write_text(json.dumps({"final": 1}))
+    run.flush_partial_observability("again")
+    assert json.loads((run.dir / "summary.json").read_text()) == {"final": 1}
 
 
 def test_fuse_counters_via_engine(traced, monkeypatch):

@@ -70,6 +70,50 @@ def test_metrics_logger_jsonl(tmp_path):
     assert all("ts" in l for l in lines)
 
 
+def test_metrics_schema_round_trip_validates(tmp_path):
+    """The r15 schema contract: every logged row carries
+    ``"schema": METRICS_SCHEMA_VERSION``; reading the file back through
+    ``validate_metrics_record`` round-trips cleanly, and a field-name
+    drift (missing round, wrong version) fails LOUDLY naming the field
+    — so the live /healthz endpoint (which reports the same version)
+    and the JSONL file can never silently disagree."""
+    from qfedx_tpu.run.metrics import (
+        METRICS_SCHEMA_VERSION,
+        validate_metrics_record,
+    )
+
+    path = tmp_path / "m.jsonl"
+    logged = [
+        {"round": 1, "loss": 0.5, "accuracy": 0.9},
+        {"round": 2, "loss": 0.4, "epsilon": 1.25, "dropped_clients": 2},
+    ]
+    with MetricsLogger(path) as log:
+        for rec in logged:
+            log.log(rec)
+    rows = [
+        validate_metrics_record(json.loads(l))
+        for l in path.read_text().splitlines()
+    ]
+    for rec, row in zip(logged, rows):
+        assert row["schema"] == METRICS_SCHEMA_VERSION
+        for k, v in rec.items():  # every logged field survives verbatim
+            assert row[k] == pytest.approx(v)
+    # drift fails loudly, naming the offender
+    with pytest.raises(ValueError, match="round"):
+        validate_metrics_record({"schema": METRICS_SCHEMA_VERSION, "ts": 1.0})
+    with pytest.raises(ValueError, match="schema"):
+        validate_metrics_record({"schema": 99, "round": 1, "ts": 1.0})
+    with pytest.raises(ValueError, match="round"):
+        validate_metrics_record(
+            {"schema": METRICS_SCHEMA_VERSION, "round": "one", "ts": 1.0}
+        )
+    # an explicit schema in the record wins (forward-written files)
+    with MetricsLogger(tmp_path / "m2.jsonl") as log:
+        log.log({"round": 1, "schema": METRICS_SCHEMA_VERSION})
+    row = json.loads((tmp_path / "m2.jsonl").read_text())
+    assert row["schema"] == METRICS_SCHEMA_VERSION
+
+
 def test_killed_writer_leaves_whole_json_lines(tmp_path):
     """The crash-safety claim, enforced: a writer dying WITHOUT close()
     or interpreter shutdown (os._exit skips flush/atexit — the OOM-kill/

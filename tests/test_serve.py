@@ -446,6 +446,139 @@ def test_serve_compute_persistent_failure_surfaces(monkeypatch):
         assert np.all(np.isfinite(f2.result(timeout=30)["logits"]))
 
 
+# -- live telemetry + request-scoped tracing (r15) -----------------------------
+
+
+from conftest import free_port as _free_port  # noqa: E402 — shared helper
+
+
+def test_metrics_scrape_reconciles_with_batcher_ledger(monkeypatch):
+    """The r15 acceptance pin: a live /metrics scrape's
+    serve.requests_served / rejected / shed counters reconcile EXACTLY
+    with the batcher's final ledger — including with QFEDX_TRACE off
+    (the live-metrics gate), while the batcher runs."""
+    import urllib.request
+
+    from qfedx_tpu.obs import server as obs_server
+
+    monkeypatch.delenv("QFEDX_TRACE", raising=False)
+    port = _free_port()
+    monkeypatch.setenv("QFEDX_METRICS_PORT", str(port))
+    obs.reset()
+    engine, _, _ = _engine(buckets=(1,), deadline_ms=5.0, max_queue=2)
+    engine.warmup()
+    started, release = threading.Event(), threading.Event()
+    orig = engine.infer
+
+    def gated(x, seq=0):
+        started.set()
+        release.wait(timeout=30)
+        return orig(x, seq)
+
+    engine.infer = gated
+    b = MicroBatcher(engine).start()
+    try:
+        assert obs_server.active_server() is not None, (
+            "batcher.start did not bring up the pinned endpoint"
+        )
+        first = b.submit(_rows(1)[0])
+        assert started.wait(timeout=10)
+        queued = [b.submit(r) for r in _rows(2, seed=1)]
+        with pytest.raises(Overloaded):
+            b.submit(_rows(1, seed=2)[0])  # shed
+        with pytest.raises(RequestError):
+            b.submit(np.zeros((N + 1,), np.float32))  # rejected (shape)
+        # /healthz mid-run: the serve source reports the live queue
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ).read())
+        assert hz["components"]["serve"]["queue_depth"] == 2
+        assert hz["components"]["serve"]["shed"] == 1
+        release.set()
+        for f in [first, *queued]:
+            f.result(timeout=30)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        scraped = {
+            line.split(" ")[0]: float(line.split(" ")[1])
+            for line in body.splitlines()
+            if line and not line.startswith("#") and "{" not in line
+        }
+        assert scraped["qfedx_serve_requests_served"] == b.stats["served"] == 3
+        assert scraped["qfedx_serve_requests_shed"] == b.stats["shed"] == 1
+        assert (
+            scraped["qfedx_serve_requests_rejected"]
+            == b.stats["rejected"]
+            == 1
+        )
+        assert scraped["qfedx_serve_batches"] == b.stats["batches"]
+        assert scraped["qfedx_serve_latency_ms_count"] == 3
+    finally:
+        release.set()
+        b.close(drain=True)
+        obs_server.stop_server()
+    # the batcher's health source unregisters on close
+    from qfedx_tpu.obs.server import health_payload
+
+    assert "serve" not in health_payload()["components"]
+
+
+def test_serve_latency_histogram_p95_within_one_bucket(monkeypatch):
+    """The histogram acceptance pin on the REAL serving path: the
+    serve.latency_ms registry histogram's p95 lands within one
+    bucket-width of the exact percentile of the futures' measured
+    latencies (and never above it)."""
+    monkeypatch.setenv("QFEDX_TRACE", "1")
+    obs.reset()
+    engine, _, _ = _engine(buckets=(1, 2, 4), deadline_ms=10.0, max_queue=64)
+    engine.warmup()
+    futs = []
+    with MicroBatcher(engine) as b:
+        for i in range(24):
+            futs.append(b.submit(_rows(1, seed=i)[0]))
+        for f in futs:
+            f.result(timeout=30)
+    exact = sorted((f.done_t - f.submit_t) * 1e3 for f in futs)
+    h = obs.registry().histos["serve.latency_ms"]
+    assert h.count == len(futs) == b.stats["served"]
+    for q in (0.50, 0.95):
+        exact_q = obs.percentile(exact, q)
+        lo, hi = obs.Histogram.bucket_bounds(exact_q)
+        approx = h.percentile(q)
+        assert approx == lo and lo <= exact_q < hi, (
+            f"q={q}: histogram {approx} not within one bucket "
+            f"[{lo}, {hi}) of exact {exact_q}"
+        )
+
+
+def test_request_ids_propagate_into_serve_spans(monkeypatch):
+    """Request-scoped tracing (r15 tentpole): the batcher propagates
+    each flush's request seqs so serve.queue AND the engine's
+    pad/compute/fetch spans carry the ids they served — per-request
+    latency is decomposable in trace.json instead of batch-only."""
+    monkeypatch.setenv("QFEDX_TRACE", "1")
+    obs.reset()
+    engine, _, _ = _engine(buckets=(1, 2, 4), deadline_ms=5000.0)
+    engine.warmup()
+    with MicroBatcher(engine) as b:
+        futs = [b.submit(r) for r in _rows(4)]  # bucket-full flush
+        for f in futs:
+            f.result(timeout=30)
+    expect = ",".join(str(f.seq) for f in futs)
+    spans = obs.registry().spans
+    for name in ("serve.queue", "serve.pad", "serve.compute", "serve.fetch"):
+        tagged = [s for s in spans if s.name == name and "reqs" in s.meta]
+        assert tagged, f"{name} spans carry no request ids"
+        assert tagged[-1].meta["reqs"] == expect, (
+            f"{name}: {tagged[-1].meta['reqs']} != {expect}"
+        )
+    # warmup spans predate any request and stay untagged
+    assert all(
+        "reqs" not in s.meta for s in spans if s.name == "serve.warmup"
+    )
+
+
 # -- restore + CLI round trip --------------------------------------------------
 
 
