@@ -77,6 +77,13 @@ Sections in ``bench_details.json`` (beyond the headline):
 - ``dense18q_bf16_scan16``: the r14 floor lever — the dense18q_bf16 step
   at scan depth 16 vs 4, reading the dispatch-gap share of the §11
   dtype-invariant floor directly (docs/PERF.md §15).
+- ``floor_attribution`` (r16, compact copy on the printed line): the
+  MEASURED floor — a profiler capture of the step program parsed by
+  ``obs/profile.py`` into executed ops vs the static ``fusion_hlo``
+  census, the measured inter-op gap quantiles (the §15 3–5 µs/op
+  inference, now measured), and device-busy fraction; ``vs_prev``
+  tracks ``gap_us_per_op`` / ``ops_per_step`` — the evidence harness
+  every op-count-collapse PR is judged against (docs/PERF.md §16).
 - ``time_to_target`` / ``time_to_target_20q``: wall-clock to target
   accuracy, flagship 8q config and the TRUE 20-qubit config-5 width
   (VERDICT r04 missing 1: 20q had been timed but never trained).
@@ -1048,7 +1055,7 @@ def _bench_fusion_hlo(jax):
     compiled-module pass counts are the chip-side follow-up via
     benchmarks/profile_step.py."""
     from benchmarks._util import build_step
-    from qfedx_tpu.obs.hlo import count_state_ops
+    from qfedx_tpu.obs.hlo import lowered_state_ops
 
     out = {}
     for n, batch in ((16, 64), (18, 16), (20, 8)):
@@ -1057,9 +1064,12 @@ def _bench_fusion_hlo(jax):
 
             def count(_j):
                 fn, params, _steps = build_step(n, 3, batch, 1)
-                return count_state_ops(
-                    fn.lower(params).as_text(), 1 << n
-                )["lowered_state_ops"]
+                # The ONE static-census helper (obs/hlo.py) this
+                # section shares with floor_attribution below and
+                # profile_step --device-profile — the static side of
+                # every measured-vs-static comparison counts ops
+                # identically (ISSUE r16 hygiene).
+                return lowered_state_ops(fn, params, n)
 
             row[label] = _with_env({"QFEDX_FUSE": pin}, count, jax)
         row["state_op_ratio"] = round(
@@ -1067,6 +1077,46 @@ def _bench_fusion_hlo(jax):
         )
         out[f"n{n}"] = row
     return out
+
+
+def _bench_floor_attribution(jax):
+    """The MEASURED floor evidence (r16; docs/PERF.md §16): a profiler
+    capture of the real step program, parsed into the runtime op census
+    (obs/profile.py) — executed ops vs the static ``fusion_hlo`` census
+    (same ``obs.hlo.lowered_state_ops`` helper), the measured inter-op
+    gap quantiles the §15 3–5 µs/op inference guessed at, and the
+    device-busy fraction. This is the before/after harness every
+    op-count-collapse PR (scan-over-fused-layers, Pallas) is judged
+    against; ``vs_prev`` tracks gap_us_per_op and ops_per_step.
+
+    Width is backend-sized: the chip profiles the dense18q production
+    step; this container's CPU profiles n=12 (a dense18q CPU step is
+    ~30 s of thunks — same math, recorded once in PERF.md §16)."""
+    import tempfile
+
+    from benchmarks._util import build_step, device_sync
+    from qfedx_tpu.obs import profile as obs_profile
+    from qfedx_tpu.obs.hlo import lowered_state_ops
+
+    on_chip = jax.default_backend() == "tpu"
+    n, batch, steps = (18, 16, 4) if on_chip else (12, 16, 2)
+    fn, params, _ = build_step(n, 3, batch, steps)
+    static = lowered_state_ops(fn, params, n)
+    params, ls = fn(params)  # warm: compile outside the capture
+    device_sync(ls)
+    with tempfile.TemporaryDirectory(prefix="qfedx-floor-") as tdir:
+        with obs_profile.capture(tdir):
+            params, ls = fn(params)
+            device_sync(params)
+        parsed = obs_profile.parse_capture(tdir)
+    summary = obs_profile.summarize(
+        parsed, static_state_ops=static, steps=steps
+    )
+    row = obs_profile.floor_attribution(static, summary)
+    row["n"] = n
+    row["batch"] = batch
+    row["steps"] = steps
+    return row
 
 
 def _target_hits(accuracies, round_times_s, target):
@@ -1498,6 +1548,21 @@ def main():
     # zero-compiles-in-loop contract measured by the compile listener.
     serve = safe(_bench_serve)
     fusion_hlo = safe(_bench_fusion_hlo)
+    # r16: the MEASURED floor — profiler capture of the step program
+    # parsed into executed ops, inter-op gap quantiles, busy fraction
+    # (the runtime complement of the static fusion_hlo census above;
+    # docs/PERF.md §16). The dense18q_bf16 bandwidth-model ratio rides
+    # along so the floor evidence reads as one unit: ops x gap next to
+    # achieved-vs-streaming-bound.
+    floor_attr = safe(_bench_floor_attribution)
+    if (
+        "error" not in floor_attr
+        and isinstance(dense18_bf16, dict)
+        and dense18_bf16.get("vs_pergate_bound") is not None
+    ):
+        floor_attr["dense18q_bf16_vs_pergate_bound"] = dense18_bf16[
+            "vs_pergate_bound"
+        ]
     ttt = safe(_bench_time_to_target)
     ttt20 = safe(
         lambda j: _with_env(
@@ -1612,6 +1677,24 @@ def main():
                 (prev.get("serve") or {}).get("throughput_at_slo"),
                 True,
             )
+            # r16 floor attribution: a growing measured gap or op count
+            # is exactly the regression the §15 model prices. Only
+            # compared when the profiled width matches (the row is
+            # backend-sized; a CPU-vs-chip prev is not a regression).
+            prev_floor = prev.get("floor_attribution") or {}
+            if prev_floor.get("n") == floor_attr.get("n"):
+                delta(
+                    "floor_gap_us_per_op",
+                    floor_attr.get("gap_us_per_op"),
+                    prev_floor.get("gap_us_per_op"),
+                    False,
+                )
+                delta(
+                    "floor_ops_per_step",
+                    floor_attr.get("ops_per_step"),
+                    prev_floor.get("ops_per_step"),
+                    False,
+                )
             delta("compute_bound_fwd_grad_s", compute.get("fwd_grad_s"),
                   prev_engine_s("compute_bound", "n16"), False)
             delta("dense18q_fwd_grad_s", dense18.get("fwd_grad_s"),
@@ -1692,6 +1775,7 @@ def main():
         "straggler": straggler,
         "serve": serve,
         "fusion_hlo": fusion_hlo,
+        "floor_attribution": floor_attr,
         "time_to_target": ttt,
         "time_to_target_20q": ttt20,
         "vs_prev": vs_prev,
@@ -1833,6 +1917,19 @@ def main():
                 "fusion_hlo_n18": fusion_hlo.get("n18")
                 if isinstance(fusion_hlo, dict)
                 else None,
+                # r16: the measured floor — executed ops vs the static
+                # census, measured inter-op gap, device-busy fraction
+                # (docs/PERF.md §16; full row in bench_details.json).
+                "floor_attribution": {
+                    k: floor_attr.get(k)
+                    for k in (
+                        "n", "ops_per_step", "static_state_ops",
+                        "measured_vs_static", "gap_us_per_op",
+                        "device_busy_fraction",
+                    )
+                }
+                if "error" not in floor_attr
+                else {"error": floor_attr["error"][:80]},
                 "time_to_target": ttt_brief(ttt),
                 "time_to_target_20q": ttt_brief(ttt20),
                 # Compact {phase: total_s} of the traced hot

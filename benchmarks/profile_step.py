@@ -220,6 +220,52 @@ def run_serve_profile(args):
     print("[serve:hlo] " + " ".join(f"{k}={v}" for k, v in counts.items()))
 
 
+def run_device_profile(args):
+    """``--device-profile`` (r16): the MEASURED device timeline of the
+    step program — a crash-safe capture parsed into the runtime op
+    census (obs/profile.py, no TF protos), printed as top-K ops, the
+    inter-op gap quantiles, device-busy fraction, and the
+    measured-vs-static floor attribution that PERF.md §16 records.
+    The static side comes through the same ``obs.hlo.lowered_state_ops``
+    helper bench.py's fusion_hlo / floor_attribution sections use."""
+    from qfedx_tpu.obs import profile as obs_profile
+    from qfedx_tpu.obs.hlo import lowered_state_ops
+
+    fn, params, steps = build_step(
+        args.n, args.layers, args.batch, args.steps, remat=args.remat
+    )
+    static = lowered_state_ops(fn, params, args.n)
+    params, ls = fn(params)  # warm: compile outside the capture window
+    device_sync(ls)
+    tdir = os.path.join(args.trace_dir, "device")
+    with obs_profile.capture(tdir):
+        params, ls = fn(params)
+        device_sync(params)
+    parsed = obs_profile.parse_capture(tdir)
+    summary = obs_profile.summarize(
+        parsed, static_state_ops=static, steps=steps
+    )
+    print(f"[device] capture: {summary['capture']} "
+          f"({summary['device_lanes']} lanes)")
+    print(f"[device] ops executed: {summary['ops_executed']} "
+          f"({summary['ops_per_step']}/step) vs static state census "
+          f"{static} -> measured_vs_static {summary['measured_vs_static']}")
+    print(f"[device] busy {summary['device_busy_s']*1e3:.1f} ms of "
+          f"{summary['device_window_s']*1e3:.1f} ms window "
+          f"(fraction {summary['device_busy_fraction']})")
+    print(f"[device] inter-op gap: p50 {summary['gap_p50_us']} us, "
+          f"p95 {summary['gap_p95_us']} us, mean {summary['gap_mean_us']} us "
+          f"over {summary['gap_count']} gaps")
+    print(f"[device] top {len(summary['top_ops'])} ops by device time:")
+    for row in summary["top_ops"]:
+        print(f"  {row['total_ms']:9.2f} ms total {row['self_ms']:9.2f} ms "
+              f"self  x{row['count']:<5d} {row['op'][:80]}")
+    print("[device:floor] " + json.dumps(
+        obs_profile.floor_attribution(static, summary)
+    ))
+    return summary
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--trace-dir", default="/tmp/qfedx-prof")
@@ -239,6 +285,13 @@ def main():
                     "(PERF.md §15; docs/SERVING.md)")
     ap.add_argument("--buckets", default="1,8,32",
                     help="--serve: comma-separated bucket batch shapes")
+    ap.add_argument("--device-profile", action="store_true",
+                    help="capture + parse the DEVICE timeline of the "
+                    "step program (obs/profile.py): measured op census "
+                    "vs the static HLO census, inter-op gap histogram "
+                    "quantiles, device-busy fraction, top-K ops — the "
+                    "measured form of the PERF.md §15 floor model "
+                    "(docs/PERF.md §16)")
     ap.add_argument("--hlo-only", action="store_true",
                     help="skip timing/tracing; report lowered + compiled "
                     "op counts with the fusion pass on vs off (the r07 "
@@ -254,6 +307,9 @@ def main():
 
     if args.serve:
         run_serve_profile(args)
+        return
+    if args.device_profile:
+        run_device_profile(args)
         return
     if args.hlo_only:
         run_hlo_counts(args)

@@ -9,7 +9,10 @@ a LIVE half: bounded log-bucketed histograms (``obs.Histogram`` /
 ``obs.histogram``), a /metrics + /healthz endpoint
 (``QFEDX_METRICS_PORT``; obs/server.py), request-scoped trace contexts
 (``obs.trace_context``), and multi-process trace shards + merge
-(``obs.write_trace_shard`` / ``obs.merge_trace_shards``).
+(``obs.write_trace_shard`` / ``obs.merge_trace_shards``). Since r16 it
+also has a DEVICE half: crash-safe profiler captures and a parsed
+device-timeline census (``obs.profile`` — measured op counts, inter-op
+gap histograms, per-span device attribution; ``QFEDX_PROFILE``).
 
 Usage::
 
@@ -26,6 +29,7 @@ instruments also record while a live /metrics endpoint is up
 (trace.metrics_enabled).
 """
 
+from qfedx_tpu.obs import profile
 from qfedx_tpu.obs.export import (
     chrome_trace_events,
     percentile,
@@ -35,8 +39,9 @@ from qfedx_tpu.obs.export import (
     write_chrome_trace,
 )
 from qfedx_tpu.obs.histo import Histogram
-from qfedx_tpu.obs.hlo import count_state_ops, module_counts
+from qfedx_tpu.obs.hlo import count_state_ops, lowered_state_ops, module_counts
 from qfedx_tpu.obs.merge import (
+    add_device_lane,
     find_shards,
     merge_trace_shards,
     shard_path,
@@ -60,6 +65,7 @@ from qfedx_tpu.obs.trace import (
 __all__ = [
     "Histogram",
     "Span",
+    "add_device_lane",
     "chrome_trace_events",
     "count_state_ops",
     "counter",
@@ -67,12 +73,14 @@ __all__ = [
     "find_shards",
     "gauge",
     "histogram",
+    "lowered_state_ops",
     "merge_trace_shards",
     "metrics_enabled",
     "module_counts",
     "percentile",
     "phase_rollup",
     "phase_totals",
+    "profile",
     "record_device_memory",
     "registry",
     "reset",

@@ -49,9 +49,16 @@ def phase_rollup(spans: list[Span] | None = None) -> dict[str, dict]:
     bucket-width of exact, always <= exact — lower-edge nearest-rank,
     obs/histo.py) while count/total/compile stay exact sums. An
     explicit span list takes the same path through ephemeral
-    histograms, so the two calls cannot disagree on definitions."""
+    histograms, so the two calls cannot disagree on definitions.
+
+    When a parsed profiler capture has attached per-span device
+    attribution (obs/profile.attach_span_device, r16), registry rows
+    additionally carry ``device_busy_s`` (clamped to the span wall) and
+    ``utilization`` in (0, 1]."""
+    device_by_name: dict = {}
     if spans is None:
         histos, compile_by_name = registry().span_rollup_source()
+        device_by_name = registry().span_device_view()
     else:
         histos = {}
         compile_by_name = {}
@@ -74,6 +81,21 @@ def phase_rollup(spans: list[Span] | None = None) -> dict[str, dict]:
         }
         if compile_by_name.get(name, 0.0) > 0:
             rows[name]["compile_s"] = round(compile_by_name[name], 6)
+        if name in device_by_name:
+            busy_s, _util = device_by_name[name]
+            total_s = rows[name]["total_s"]
+            busy_s = round(min(busy_s, total_s), 6)
+            # A clamp that zeroes the column (a µs-wall span whose
+            # annotation window caught unrelated async device work) is
+            # noise, not attribution — leave the row without columns.
+            # utilization is recomputed over THIS row's wall so the two
+            # columns can never contradict each other (the summary's
+            # spans table keeps the annotation-wall ratio).
+            if busy_s > 0 and total_s > 0:
+                rows[name]["device_busy_s"] = busy_s
+                rows[name]["utilization"] = round(
+                    min(1.0, busy_s / total_s), 4
+                )
     return dict(sorted(rows.items(), key=lambda kv: -kv[1]["total_s"]))
 
 

@@ -46,6 +46,17 @@ def count_state_ops(txt: str, min_elems: int) -> dict:
     return {"lowered_ops": total, "lowered_state_ops": state}
 
 
+def lowered_state_ops(fn, params, n_qubits) -> int:
+    """The static state-sized-op count of a jitted step program —
+    lowering only, no backend compile. The ONE helper behind bench.py's
+    ``fusion_hlo`` and ``floor_attribution`` sections and
+    ``profile_step.py --device-profile``, so the static side of every
+    measured-vs-static comparison counts ops identically."""
+    return count_state_ops(fn.lower(params).as_text(), 1 << n_qubits)[
+        "lowered_state_ops"
+    ]
+
+
 def module_counts(fn, params, n_qubits, compiled=True):
     """Op counts of a step program at two altitudes: the LOWERED
     (StableHLO) module — split into state-sized vs small ops (see

@@ -39,6 +39,61 @@ from qfedx_tpu.obs.trace import registry
 
 _SHARD_RE = re.compile(r"^trace\.(\d+)\.json$")
 
+# The device-op lane (r16): parsed profiler captures land in their own
+# Perfetto process lane, past any plausible jax.process_index() so host
+# lanes and the device lane can never collide in a merged file.
+DEVICE_LANE_PID = 1000
+
+
+def add_device_lane(
+    trace_obj: dict,
+    device_events: list[dict],
+    offset_us: float = 0.0,
+    label: str = "qfedx device",
+) -> dict:
+    """Append a parsed capture's device-op intervals (obs/profile.py
+    ``device_events``: {name, ts, dur, lane}) as their own process lane
+    in ``trace_obj`` (a chrome-trace dict), shifted by ``offset_us``
+    onto the host spans' clock (obs/profile.align_offset_us) — one
+    Perfetto file then shows host spans, request-id meta and device ops
+    on aligned tracks. Mutates and returns ``trace_obj``."""
+    events = trace_obj.setdefault("traceEvents", [])
+    events.append(
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": DEVICE_LANE_PID,
+            "tid": 0,
+            "args": {"name": label},
+        }
+    )
+    seen_lanes: set[int] = set()
+    for e in device_events:
+        lane = int(e.get("lane", 0))
+        if lane not in seen_lanes:
+            seen_lanes.add(lane)
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": DEVICE_LANE_PID,
+                    "tid": lane,
+                    "args": {"name": f"device lane {lane}"},
+                }
+            )
+        events.append(
+            {
+                "name": e["name"],
+                "ph": "X",
+                "ts": round(e["ts"] + offset_us, 3),
+                "dur": round(e["dur"], 3),
+                "pid": DEVICE_LANE_PID,
+                "tid": lane,
+                "args": {},
+            }
+        )
+    return trace_obj
+
 
 def _process_index() -> int:
     try:

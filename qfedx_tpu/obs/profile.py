@@ -1,0 +1,609 @@
+"""Device-timeline profiling: crash-safe captures + a parsed op census.
+
+Why this exists: the repo's single highest-leverage speed item — the
+~2800-executed-op inter-op floor (docs/PERF.md §15) — rested on an
+*inferred* number: a static HLO census (obs/hlo.py) divided by wall
+time. ``--profile`` wrote a profiler capture nobody ever read, and the
+``QFEDX_TRACE_XLA`` span bridge annotated profiles nobody analyzed.
+This module closes the loop:
+
+- ``capture(log_dir)`` — a crash-safe ``jax.profiler.start_trace`` /
+  ``stop_trace`` context: SIGTERM rides the ``utils/host`` translation
+  into KeyboardInterrupt so the unwind stops the trace, and stop runs
+  on ANY exception — a killed run still leaves a parseable capture
+  (the bare ``jax.profiler.trace`` at the old CLI seam could not
+  survive a TERM). A ``capture_meta.json`` anchor (registry-clock
+  instant of the start) lands next to the capture for merge alignment.
+- ``parse_capture`` / ``parse_events`` — read the emitted
+  Perfetto/trace-event JSON (``*.trace.json.gz``) with NO TF-proto
+  dependency and produce the *measured* runtime census: executed-op
+  events (detected by their ``hlo_op`` args, falling back to
+  device-named pids on backends that drop the args), per-op
+  total/self device time, an **inter-op gap histogram** (consecutive
+  top-level ops per device lane, recorded in µs through the bounded
+  ``obs.Histogram``), device-busy vs window time, and **span
+  correlation**: ``QFEDX_TRACE_XLA`` annotation ranges in the same
+  capture matched against the registry's span names.
+- ``summarize`` / ``write_profile_summary`` — the
+  ``profile_summary.json`` artifact (schema guarded both directions by
+  ``benchmarks/check_profile.py`` against the docs/OBSERVABILITY.md
+  table) plus ``attach_span_device``, which feeds per-span
+  ``device_busy_s``/``utilization`` into ``obs.phase_rollup`` rows.
+- ``write_merged_trace`` — host spans + request ids + the device-op
+  lane on ONE aligned Perfetto timeline (obs/merge.add_device_lane),
+  annotation-anchored exactly, meta-anchored (~ms) without the bridge.
+
+``QFEDX_PROFILE`` (the pin twin of the ``--profile`` flag): unset /
+``0`` / ``off`` → no capture (default-off invariance: no profiler
+session, no files, no threads); ``1`` / ``on`` → capture to the
+caller's default dir (the CLI uses ``<run-dir>/profile``); a path →
+capture there. Same grammar shape as ``QFEDX_COMPILE_CACHE``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import gzip
+import json
+import os
+import re
+import time
+from pathlib import Path
+
+from qfedx_tpu.obs.histo import Histogram
+from qfedx_tpu.obs.trace import registry
+from qfedx_tpu.utils import pins
+
+PROFILE_SUMMARY_SCHEMA_VERSION = 1
+
+# The profile_summary.json field contract — ONE definition, mirrored by
+# the docs/OBSERVABILITY.md schema table and guarded both directions by
+# benchmarks/check_profile.py (the check_spans pattern): a field emitted
+# here without a doc row fails tier-1, and a stale doc row fails too.
+SUMMARY_FIELDS: dict[str, str] = {
+    "schema": "profile_summary schema version (this table is version 1)",
+    "capture": "file name of the parsed trace capture",
+    "ops_executed": "executed top-level device-op slots (nested "
+                    "sub-ops fold into their parent) — the same slots "
+                    "the gap histogram and busy time are defined over",
+    "ops_distinct": "distinct HLO op instances among those slots",
+    "ops_per_step": "ops_executed / steps (null when steps unknown)",
+    "static_state_ops": "lowered state-sized-op census of the same "
+                        "program (obs/hlo.py; null when not supplied)",
+    "measured_vs_static": "ops_executed (per step) / static_state_ops",
+    "device_busy_s": "summed top-level device-op time (all lanes)",
+    "device_window_s": "first-op-start to last-op-end window",
+    "device_busy_fraction": "fraction of the window where ANY device "
+                            "lane ran an op (interval union / window)",
+    "device_lanes": "device lanes (threads) carrying op events",
+    "gap_count": "inter-op gaps measured (consecutive ops per lane)",
+    "gap_p50_us": "median inter-op idle gap (bounded-histogram quantile)",
+    "gap_p95_us": "p95 inter-op idle gap",
+    "gap_mean_us": "mean inter-op idle gap",
+    "top_ops": "top ops by total device time ({op, count, total_ms, "
+               "self_ms} rows)",
+    "spans": "per-span device attribution ({wall_s, device_busy_s, "
+             "utilization} by span name; QFEDX_TRACE_XLA captures only)",
+}
+
+_TOP_K = 15
+_META_NAME = "capture_meta.json"
+_OP_ID_RE = re.compile(r"\.\d+$")
+
+# Control-flow CONTAINER ops: XLA emits one event spanning the whole
+# region (a while thunk covers every iteration of a scanned body), with
+# the real per-iteration ops nested inside. They are not scheduling
+# slots — left in, one while would swallow a 2000-op scan into a single
+# "top-level op" and erase the gap census.
+_TRANSPARENT_OPS = {"while", "conditional", "call"}
+
+
+def profile_dir(default: str | None = None) -> str | None:
+    """Resolve QFEDX_PROFILE to a capture directory, or None when the
+    pin is off/unset (see module docstring; loud on typos like every
+    QFEDX_* pin)."""
+    env = os.environ.get("QFEDX_PROFILE")
+    if env is None:
+        return None
+    as_bool = pins.parse_onoff(env)
+    if as_bool is False:
+        return None
+    if as_bool is True:
+        return default
+    if os.sep in env or env.startswith(("~", ".")):
+        return os.path.expanduser(env)
+    raise ValueError(
+        f"QFEDX_PROFILE={env!r}: expected '0'/'off', '1'/'on' or a "
+        "directory path (with a path separator or ~/. prefix)"
+    )
+
+
+class capture:
+    """Crash-safe profiler capture into ``log_dir``.
+
+    ``with capture(dir):`` starts a ``jax.profiler`` trace and ALWAYS
+    stops it — on clean exit, on any exception, and on SIGTERM (which
+    the ``utils/host`` translation turns into KeyboardInterrupt on the
+    main thread, so the unwind reaches the stop). A stop failure never
+    masks the in-flight exception. The registry-clock anchor of the
+    start instant is written as ``capture_meta.json`` so a merger can
+    align the capture with host spans even without annotations."""
+
+    def __init__(self, log_dir: str | Path):
+        self.log_dir = Path(log_dir)
+        self._token = None
+        self._started = False
+
+    def __enter__(self):
+        from qfedx_tpu.utils import host
+
+        self._token = host.install_sigterm_interrupt()
+        try:
+            self.log_dir.mkdir(parents=True, exist_ok=True)
+            import jax
+
+            jax.profiler.start_trace(str(self.log_dir))
+        except BaseException:
+            # __exit__ never runs after a failed __enter__ — restore the
+            # handler here or the translation leaks for process life.
+            host.restore_sigterm(self._token)
+            raise
+        self._started = True
+        reg = registry()
+        meta = {
+            "start_rel_origin_us": (time.perf_counter() - reg.origin) * 1e6,
+            "origin_unix": reg.origin_unix,
+            "unix_start": time.time(),
+        }
+        try:
+            (self.log_dir / _META_NAME).write_text(json.dumps(meta))
+        except OSError:  # the anchor is an alignment aid, not the capture
+            pass
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        from qfedx_tpu.utils import host
+
+        try:
+            if self._started:
+                import jax
+
+                jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001 — a stop failure must not mask
+            if exc_type is None:  # the unwind that got us here
+                raise
+        finally:
+            host.restore_sigterm(self._token)
+        return False
+
+
+def find_capture(log_dir: str | Path) -> Path | None:
+    """Newest ``*.trace.json(.gz)`` under ``log_dir`` (the profiler
+    nests captures under ``plugins/profile/<session>/``)."""
+    paths = [
+        p
+        for pattern in ("*.trace.json.gz", "*.trace.json")
+        for p in Path(log_dir).rglob(pattern)
+    ]
+    return max(paths, key=lambda p: p.stat().st_mtime) if paths else None
+
+
+def load_capture(path: str | Path) -> list[dict]:
+    """The traceEvents list of one capture file (.gz or plain JSON)."""
+    path = Path(path)
+    if path.suffix == ".gz":
+        with gzip.open(path, "rt") as f:
+            return json.load(f).get("traceEvents", [])
+    return json.loads(path.read_text()).get("traceEvents", [])
+
+
+def _device_pids(events) -> set:
+    """pids whose process_name says device — the fallback op detector
+    for backends whose op events carry no ``hlo_op`` args (TPU lanes
+    name the process, CPU names the thunk thread)."""
+    out = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            name = (e.get("args") or {}).get("name", "")
+            if "/device" in name.lower() or "TPU" in name or "Chip" in name:
+                out.add(e.get("pid"))
+    return out
+
+
+def _op_events(events) -> list[dict]:
+    """The executed-op events: X events carrying an ``hlo_op`` arg
+    (XLA:CPU thunks and annotated device ops), else every X event on a
+    device-named pid."""
+    ops = [
+        e
+        for e in events
+        if e.get("ph") == "X" and "hlo_op" in (e.get("args") or {})
+    ]
+    if ops:
+        return ops
+    dev = _device_pids(events)
+    return [e for e in events if e.get("ph") == "X" and e.get("pid") in dev]
+
+
+def _toplevel_by_lane(ops) -> dict[tuple, list[tuple[float, float, str]]]:
+    """Per (pid, tid) lane: the TOP-LEVEL op intervals (ts, dur, name),
+    ts-sorted. Nested events (a fusion's sub-ops) are folded into their
+    parent — gaps and busy time are defined over scheduling slots, not
+    over an op's internal decomposition."""
+    lanes: dict[tuple, list] = {}
+    for e in ops:
+        lanes.setdefault((e.get("pid"), e.get("tid")), []).append(e)
+    out = {}
+    for key, evs in lanes.items():
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        top: list[tuple[float, float, str]] = []
+        end = -1.0
+        for e in evs:
+            if e["ts"] >= end - 1e-9:  # not inside the previous top op
+                top.append((e["ts"], e["dur"], e.get("name", "?")))
+                end = e["ts"] + e["dur"]
+        out[key] = top
+    return out
+
+
+def _self_times(ops) -> dict[str, float]:
+    """Per-op-name SELF µs: duration minus directly-nested children on
+    the same lane (a fusion's reported total includes its sub-events
+    where the backend emits them)."""
+    lanes: dict[tuple, list] = {}
+    for e in ops:
+        lanes.setdefault((e.get("pid"), e.get("tid")), []).append(e)
+    self_us: dict[str, float] = {}
+    for evs in lanes.values():
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack: list[list] = []  # [end, child_us, name, dur]
+        for e in evs:
+            while stack and e["ts"] >= stack[-1][0] - 1e-9:
+                end, child, name, dur = stack.pop()
+                self_us[name] = self_us.get(name, 0.0) + max(0.0, dur - child)
+            if stack:
+                stack[-1][1] += e["dur"]
+            stack.append([e["ts"] + e["dur"], 0.0, e.get("name", "?"), e["dur"]])
+        while stack:
+            end, child, name, dur = stack.pop()
+            self_us[name] = self_us.get(name, 0.0) + max(0.0, dur - child)
+    return self_us
+
+
+def op_base_name(name: str) -> str:
+    """``fusion.123`` → ``fusion`` — the census groups HLO op instances
+    by their base name (the instance ids are compile-run noise)."""
+    return _OP_ID_RE.sub("", name)
+
+
+def parse_events(events: list[dict], span_names=()) -> dict:
+    """Pure parse of one capture's traceEvents (fixture-testable).
+
+    Returns the raw measured timeline: op census (base name → count /
+    total / self µs), per-lane top-level intervals, the inter-op gap
+    ``obs.Histogram`` (µs), busy/window totals, and the annotation
+    ranges whose names appear in ``span_names`` (the QFEDX_TRACE_XLA
+    bridge mirrors registry spans into the capture under their span
+    names — per-span device attribution reads them back out)."""
+    ops = [
+        e
+        for e in _op_events(events)
+        if op_base_name(e.get("name", "?")) not in _TRANSPARENT_OPS
+    ]
+    lanes = _toplevel_by_lane(ops)
+    self_us = _self_times(ops)
+
+    census: dict[str, dict] = {}
+    for e in ops:
+        name = e.get("name", "?")
+        row = census.setdefault(
+            op_base_name(name), {"count": 0, "total_us": 0.0, "self_us": 0.0}
+        )
+        row["count"] += 1
+        row["total_us"] += e["dur"]
+    for name, s in self_us.items():
+        census[op_base_name(name)]["self_us"] += s
+
+    gap_hist = Histogram()  # recorded in MICROSECONDS (units are ours)
+    gap_sum = 0.0
+    busy_us = 0.0
+    t_lo, t_hi = None, None
+    device_events = []
+    intervals: list[tuple[float, float]] = []
+    for lane_idx, (key, top) in enumerate(sorted(lanes.items())):
+        prev_end = None
+        for ts, dur, name in top:
+            busy_us += dur
+            intervals.append((ts, ts + dur))
+            t_lo = ts if t_lo is None else min(t_lo, ts)
+            t_hi = ts + dur if t_hi is None else max(t_hi, ts + dur)
+            if prev_end is not None:
+                gap = max(0.0, ts - prev_end)
+                gap_hist.record(gap)
+                gap_sum += gap
+            prev_end = ts + dur
+            device_events.append(
+                {"name": name, "ts": ts, "dur": dur, "lane": lane_idx}
+            )
+    # Busy fraction over the UNION of op intervals across lanes: "was
+    # any device lane running an op" — a near-idle helper lane (the
+    # XLA:CPU while-thunk thread) must not halve the reported fraction
+    # the way a per-lane mean would.
+    union_us = 0.0
+    cur_lo, cur_hi = None, None
+    for lo, hi in sorted(intervals):
+        if cur_hi is None or lo > cur_hi:
+            if cur_hi is not None:
+                union_us += cur_hi - cur_lo
+            cur_lo, cur_hi = lo, hi
+        else:
+            cur_hi = max(cur_hi, hi)
+    if cur_hi is not None:
+        union_us += cur_hi - cur_lo
+
+    # Annotation ranges: X events named like registry spans, NOT op
+    # events (the TraceAnnotation lane is the host thread's). Overlap
+    # is computed per lane by bisect over the sorted disjoint top-level
+    # intervals + a duration prefix sum — a traced run has thousands of
+    # annotations over tens of thousands of ops, and the naive product
+    # scan does not survive that.
+    lane_index = []
+    for top in lanes.values():
+        starts = [ts for ts, _d, _n in top]
+        ends = [ts + d for ts, d, _n in top]
+        prefix = [0.0]
+        for _ts, d, _n in top:
+            prefix.append(prefix[-1] + d)
+        lane_index.append((starts, ends, prefix))
+
+    def _lane_overlap(starts, ends, prefix, a0, a1):
+        i0 = bisect.bisect_right(ends, a0)  # first interval ending past a0
+        i1 = bisect.bisect_left(starts, a1)  # first interval starting at/after a1
+        if i0 >= i1:
+            return 0.0
+        total = prefix[i1] - prefix[i0]
+        total -= max(0.0, a0 - starts[i0])  # clip the boundary intervals
+        total -= max(0.0, ends[i1 - 1] - a1)
+        return max(0.0, total)
+
+    names = set(span_names)
+    op_ids = {id(e) for e in ops}
+    annotations: dict[str, dict] = {}
+    ann_occurrences: dict[str, list] = {}
+    for e in events:
+        if (
+            e.get("ph") != "X"
+            or e.get("name") not in names
+            or id(e) in op_ids
+        ):
+            continue
+        a0, a1 = e["ts"], e["ts"] + e["dur"]
+        overlap = sum(
+            _lane_overlap(starts, ends, prefix, a0, a1)
+            for starts, ends, prefix in lane_index
+        )
+        # Multiple device lanes can sum past the annotation's own wall;
+        # clamp per occurrence so busy <= wall holds by construction.
+        overlap = min(overlap, e["dur"])
+        row = annotations.setdefault(
+            e["name"], {"count": 0, "wall_us": 0.0, "busy_us": 0.0}
+        )
+        row["count"] += 1
+        row["wall_us"] += e["dur"]
+        row["busy_us"] += overlap
+        ann_occurrences.setdefault(e["name"], []).append(a0)
+
+    return {
+        "census": census,
+        # Executed SLOTS: top-level intervals only, the same universe
+        # the gap histogram, busy time and device lane are defined
+        # over — ops x gap must price the floor with one slot
+        # definition, so a backend that emits nested sub-events cannot
+        # inflate the numerator (the census keeps every event for time
+        # attribution; this count does not).
+        "ops_executed": len(device_events),
+        "ops_distinct": len({e["name"] for e in device_events}),
+        "device_lanes": len(lanes),
+        "device_events": device_events,
+        "busy_us": busy_us,
+        "union_busy_us": union_us,
+        "window_us": 0.0 if t_lo is None else t_hi - t_lo,
+        "gap_hist": gap_hist,
+        "gap_sum_us": gap_sum,
+        "annotations": annotations,
+        "annotation_ts": {k: sorted(v) for k, v in ann_occurrences.items()},
+        "t_min_us": min(
+            (e["ts"] for e in events if e.get("ph") == "X"), default=0.0
+        ),
+    }
+
+
+def parse_capture(log_dir: str | Path, span_names=None) -> dict:
+    """Parse the newest capture under ``log_dir``. Loud when none
+    exists — a silent empty parse would read as an idle-but-healthy
+    device. ``span_names`` defaults to every span name the registry has
+    recorded (the annotation-correlation universe)."""
+    path = find_capture(log_dir)
+    if path is None:
+        raise FileNotFoundError(
+            f"no *.trace.json(.gz) capture under {log_dir} — did the "
+            "profiled region run inside obs.profile.capture()?"
+        )
+    if span_names is None:
+        histos, _ = registry().span_rollup_source()
+        span_names = set(histos)
+    parsed = parse_events(load_capture(path), span_names)
+    parsed["capture_path"] = path
+    meta_path = Path(log_dir) / _META_NAME
+    if meta_path.exists():
+        try:
+            parsed["capture_meta"] = json.loads(meta_path.read_text())
+        except (OSError, ValueError):
+            pass
+    return parsed
+
+
+def summarize(
+    parsed: dict,
+    static_state_ops: int | None = None,
+    steps: int | None = None,
+) -> dict:
+    """The ``profile_summary.json`` dict — exactly the SUMMARY_FIELDS
+    keys (guarded against the docs table by check_profile.py). Gap
+    quantiles come from the bounded histogram (obs/histo.py: lower
+    bucket edge, within ~10% of exact, never above)."""
+    h: Histogram = parsed["gap_hist"]
+    ops = parsed["ops_executed"]
+    per_step = None if not steps else ops / steps
+    vs_static = None
+    if static_state_ops:
+        vs_static = round((per_step or ops) / static_state_ops, 3)
+    window = parsed["window_us"]
+    top = sorted(
+        parsed["census"].items(), key=lambda kv: -kv[1]["total_us"]
+    )[:_TOP_K]
+    spans = {}
+    for name, row in parsed["annotations"].items():
+        # Spans the device barely touched are attribution noise, not
+        # signal: sub-µs overlap is async-dispatch skew (an enqueue-only
+        # span), and a utilization that rounds to 0.0000 (a seconds-long
+        # compile span grazing one op) would emit a 0-row that violates
+        # the utilization ∈ (0, 1] contract.
+        if row["wall_us"] <= 0 or row["busy_us"] < 1.0:
+            continue
+        util = round(min(1.0, row["busy_us"] / row["wall_us"]), 4)
+        if util <= 0:
+            continue
+        spans[name] = {
+            "wall_s": round(row["wall_us"] / 1e6, 6),
+            "device_busy_s": round(row["busy_us"] / 1e6, 6),
+            "utilization": util,
+        }
+    cap = parsed.get("capture_path")
+    return {
+        "schema": PROFILE_SUMMARY_SCHEMA_VERSION,
+        "capture": None if cap is None else Path(cap).name,
+        "ops_executed": ops,
+        "ops_distinct": parsed["ops_distinct"],
+        "ops_per_step": None if per_step is None else round(per_step, 1),
+        "static_state_ops": static_state_ops,
+        "measured_vs_static": vs_static,
+        "device_busy_s": round(parsed["busy_us"] / 1e6, 6),
+        "device_window_s": round(window / 1e6, 6),
+        "device_busy_fraction": (
+            None if window <= 0
+            else round(min(1.0, parsed["union_busy_us"] / window), 4)
+        ),
+        "device_lanes": parsed["device_lanes"],
+        "gap_count": h.count,
+        "gap_p50_us": round(h.percentile(0.50), 3),
+        "gap_p95_us": round(h.percentile(0.95), 3),
+        "gap_mean_us": (
+            0.0 if h.count == 0 else round(parsed["gap_sum_us"] / h.count, 3)
+        ),
+        "top_ops": [
+            {
+                "op": name,
+                "count": row["count"],
+                "total_ms": round(row["total_us"] / 1e3, 3),
+                "self_ms": round(row["self_us"] / 1e3, 3),
+            }
+            for name, row in top
+        ],
+        "spans": spans,
+    }
+
+
+def attach_span_device(summary: dict) -> None:
+    """Feed the summary's per-span device attribution into the registry
+    so ``obs.phase_rollup`` rows (and summary.json's phase_breakdown)
+    carry ``device_busy_s``/``utilization`` columns for a profiled
+    run."""
+    reg = registry()
+    for name, row in (summary.get("spans") or {}).items():
+        reg.set_span_device(
+            name, row["device_busy_s"], row["utilization"]
+        )
+
+
+def floor_attribution(static_state_ops: int | None, summary: dict) -> dict:
+    """The floor-evidence row bench.py and profile_step.py share: the
+    §15 inference (static census ÷ wall) next to the MEASURED per-op
+    gap and busy fraction — the before/after harness every op-count-
+    collapse PR is judged against (docs/PERF.md §16)."""
+    return {
+        "static_state_ops": static_state_ops,
+        "ops_executed": summary["ops_executed"],
+        "ops_per_step": summary["ops_per_step"],
+        "measured_vs_static": summary["measured_vs_static"],
+        "gap_us_per_op": summary["gap_p50_us"],
+        "gap_p95_us": summary["gap_p95_us"],
+        "device_busy_fraction": summary["device_busy_fraction"],
+        "device_lanes": summary["device_lanes"],
+    }
+
+
+def align_offset_us(parsed: dict) -> float | None:
+    """Offset (µs) that rebases the capture's clock onto the registry
+    span timeline. Exact when QFEDX_TRACE_XLA annotations are in the
+    capture (k-th annotation of a name matches the k-th registry span
+    of that name); falls back to the capture_meta.json start anchor
+    (~ms accuracy); None when neither exists."""
+    reg = registry()
+    spans_by_name: dict[str, list[float]] = {}
+    # Same read discipline as export.chrome_trace_events: the span list
+    # is append-only, so exporters iterate it without the lock.
+    for sp in list(reg.spans):
+        spans_by_name.setdefault(sp.name, []).append(sp.t0)
+    offsets = []
+    for name, ann_ts in parsed.get("annotation_ts", {}).items():
+        reg_ts = sorted(spans_by_name.get(name, []))
+        for a, t0 in zip(ann_ts, reg_ts):
+            offsets.append((t0 - reg.origin) * 1e6 - a)
+    if offsets:
+        offsets.sort()
+        return offsets[len(offsets) // 2]
+    meta = parsed.get("capture_meta")
+    if meta and "start_rel_origin_us" in meta:
+        return meta["start_rel_origin_us"] - parsed.get("t_min_us", 0.0)
+    return None
+
+
+def write_merged_trace(path: str | Path, parsed: dict) -> Path:
+    """One Perfetto file: the registry's host spans (request ids in
+    their args) plus the capture's device-op lane, on a shared time
+    origin (see ``align_offset_us``)."""
+    from qfedx_tpu.obs.export import chrome_trace_events
+    from qfedx_tpu.obs.merge import add_device_lane
+
+    trace = {
+        "traceEvents": chrome_trace_events(),
+        "displayTimeUnit": "ms",
+    }
+    offset = align_offset_us(parsed)
+    add_device_lane(
+        trace, parsed["device_events"], 0.0 if offset is None else offset
+    )
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(trace))
+    return path
+
+
+def write_profile_summary(
+    run_dir: str | Path,
+    capture_dir: str | Path | None = None,
+    static_state_ops: int | None = None,
+    steps: int | None = None,
+) -> dict:
+    """Parse ``capture_dir`` (default ``<run_dir>/profile``), attach
+    span device columns to the registry, and write
+    ``<run_dir>/profile_summary.json``. Returns the summary."""
+    run_dir = Path(run_dir)
+    parsed = parse_capture(capture_dir or run_dir / "profile")
+    summary = summarize(parsed, static_state_ops, steps)
+    attach_span_device(summary)
+    (run_dir / "profile_summary.json").write_text(
+        json.dumps(summary, indent=2)
+    )
+    return summary

@@ -152,6 +152,11 @@ class _Registry:
         self.histos: dict[str, Histogram] = {}
         self.span_histos: dict[str, Histogram] = {}
         self.span_compile: dict[str, float] = {}
+        # Per-span-name DEVICE attribution (r16): measured device-busy
+        # seconds + utilization from a parsed profiler capture
+        # (obs/profile.attach_span_device) — phase_rollup merges these
+        # into its rows so a profiled run's summary carries them.
+        self.span_device: dict[str, tuple[float, float]] = {}
         self.origin = time.perf_counter()
         # Wall-clock instant of ``origin``: the cross-process alignment
         # anchor trace shards carry (obs/merge.py) — perf_counter is
@@ -219,6 +224,16 @@ class _Registry:
         for ``_lock`` themselves."""
         with self._lock:
             return dict(self.span_histos), dict(self.span_compile)
+
+    def set_span_device(
+        self, name: str, busy_s: float, utilization: float
+    ) -> None:
+        with self._lock:
+            self.span_device[name] = (float(busy_s), float(utilization))
+
+    def span_device_view(self) -> dict[str, tuple[float, float]]:
+        with self._lock:
+            return dict(self.span_device)
 
 
 _REGISTRY = _Registry()

@@ -144,7 +144,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--plots", action="store_true",
                    help="save client-sample and class-distribution PNGs to the run dir")
     t.add_argument("--profile", action="store_true",
-                   help="capture a jax.profiler trace of the training rounds into the run dir")
+                   help="crash-safe jax.profiler capture of the training "
+                        "rounds into the run dir (also QFEDX_PROFILE=1): the "
+                        "device timeline is parsed into profile_summary.json "
+                        "— measured op census, inter-op gap histogram, "
+                        "device-busy fraction (docs/OBSERVABILITY.md)")
     t.add_argument("--trace", action="store_true",
                    help="record per-phase spans (sets QFEDX_TRACE=1): phase "
                         "walls join every metrics.jsonl row, summary.json "
@@ -180,6 +184,15 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("--trace", action="store_true",
                    help="record serve.* spans and write trace.json next to "
                         "the run dir's artifacts (docs/OBSERVABILITY.md)")
+
+    i = sub.add_parser(
+        "inspect",
+        help="summarize a tracked run directory: metrics.jsonl trajectory "
+             "+ casualty/byzantine/staleness ledger totals, summary.json, "
+             "and profile_summary.json when present",
+    )
+    i.add_argument("run_dir",
+                   help="a tracked run directory (metrics.jsonl inside)")
 
     d = sub.add_parser("demo", help="encoder walkthrough (reference testEncoder parity)")
     d.add_argument("--dataset", default="mnist",
@@ -322,30 +335,78 @@ def run_train(
         )
         import contextlib
 
+        # --profile and the QFEDX_PROFILE pin share one resolution: the
+        # flag captures to <run-dir>/profile, the pin can redirect it.
+        # The capture context is crash-safe (stop on exception/SIGTERM —
+        # the bare jax.profiler.trace this replaced could leave a torn
+        # capture), and the parse below runs in a finally so even a
+        # killed run gets its profile_summary.json.
+        prof_dir = obs.profile.profile_dir(str(run.dir / "profile"))
+        if profile and prof_dir is None:
+            prof_dir = str(run.dir / "profile")
+        xla_bridge_set = False
+        if prof_dir is not None and trace and "QFEDX_TRACE_XLA" not in os.environ:
+            # Mirror spans into the capture so the parser can attribute
+            # device time per phase (span correlation); costs one C++
+            # annotation per span, only worth paying while profiling —
+            # restored in the finally so it cannot leak past this run
+            # in a long-lived process.
+            os.environ["QFEDX_TRACE_XLA"] = "1"
+            xla_bridge_set = True
         profile_ctx = (
-            jax_profiler_trace(run.dir / "profile") if profile else contextlib.nullcontext()
+            obs.profile.capture(prof_dir) if prof_dir is not None
+            else contextlib.nullcontext()
         )
-        with profile_ctx:
-            result = train_federated(
-                model,
-                cfg.fed,
-                data["cx"],
-                data["cy"],
-                data["cmask"],
-                eval_x,
-                eval_y,
-                num_rounds=cfg.num_rounds,
-                seed=cfg.seed,
-                eval_every=cfg.eval_every,
-                eval_batches=cfg.eval_batches,
-                rounds_per_call=cfg.rounds_per_call,
-                pipeline_depth=cfg.pipeline_depth,
-                on_round_end=lambda r, m: (
-                    run.on_round_end(r, m),
-                    say(f"[round {r + 1:3d}] " + json.dumps(m)) if (r + 1) % 5 == 0 else None,
-                )[0],
-                checkpointer=run.checkpointer(every=cfg.checkpoint_every),
-            )
+        prof_parsed = None
+        try:
+            with profile_ctx:
+                result = train_federated(
+                    model,
+                    cfg.fed,
+                    data["cx"],
+                    data["cy"],
+                    data["cmask"],
+                    eval_x,
+                    eval_y,
+                    num_rounds=cfg.num_rounds,
+                    seed=cfg.seed,
+                    eval_every=cfg.eval_every,
+                    eval_batches=cfg.eval_batches,
+                    rounds_per_call=cfg.rounds_per_call,
+                    pipeline_depth=cfg.pipeline_depth,
+                    on_round_end=lambda r, m: (
+                        run.on_round_end(r, m),
+                        say(f"[round {r + 1:3d}] " + json.dumps(m)) if (r + 1) % 5 == 0 else None,
+                    )[0],
+                    checkpointer=run.checkpointer(every=cfg.checkpoint_every),
+                )
+        finally:
+            if xla_bridge_set:
+                os.environ.pop("QFEDX_TRACE_XLA", None)
+            if prof_dir is not None and is_primary():
+                # Parse the capture even on the crash path — the killed
+                # run is the one that most needs its device timeline.
+                # (Same steps as obs.profile.write_profile_summary; the
+                # parsed timeline is kept for the merged device-lane
+                # trace below.)
+                try:
+                    prof_parsed = obs.profile.parse_capture(prof_dir)
+                    psum = obs.profile.summarize(prof_parsed)
+                    obs.profile.attach_span_device(psum)
+                    (run.dir / "profile_summary.json").write_text(
+                        json.dumps(psum, indent=2)
+                    )
+                except Exception as exc:  # noqa: BLE001 — reporting must
+                    say(f"[qfedx_tpu] profile parse failed: {exc}")  # not
+                    prof_parsed = None  # mask the run's own outcome
+                else:
+                    say(
+                        "[qfedx_tpu] profile summary: "
+                        f"{run.dir / 'profile_summary.json'} "
+                        f"(ops={psum['ops_executed']}, "
+                        f"gap_p50={psum['gap_p50_us']}us, "
+                        f"busy={psum['device_busy_fraction']})"
+                    )
         # result.evaluate is mesh-aware (sv-sharded models can't be
         # evaluated through bare model.apply).
         with obs.span("final.eval"):
@@ -367,9 +428,18 @@ def run_train(
         if obs.enabled() and is_primary():
             # Works for externally-set QFEDX_TRACE=1 too, not just
             # --trace — the pin is the contract, the flag is sugar.
-            trace_path = obs.write_chrome_trace(run.dir / "trace.json")
-            say(f"[qfedx_tpu] phase trace: {trace_path} "
-                "(load in Perfetto / chrome://tracing)")
+            # A parsed profiler capture adds the device-op lane on the
+            # same timeline (obs/profile.align_offset_us).
+            if prof_parsed is not None:
+                trace_path = obs.profile.write_merged_trace(
+                    run.dir / "trace.json", prof_parsed
+                )
+                say(f"[qfedx_tpu] phase trace: {trace_path} "
+                    "(host spans + device lane; load in Perfetto)")
+            else:
+                trace_path = obs.write_chrome_trace(run.dir / "trace.json")
+                say(f"[qfedx_tpu] phase trace: {trace_path} "
+                    "(load in Perfetto / chrome://tracing)")
         say("[qfedx_tpu] " + json.dumps(summary))
         return summary
 
@@ -541,13 +611,118 @@ def run_serve(args) -> dict:
     return summary
 
 
-def jax_profiler_trace(log_dir):
-    """jax.profiler.trace context (TensorBoard-loadable trace of the rounds
-    — the wall-clock observability the reference roadmap wants tracked,
-    ROADMAP.md:114)."""
-    import jax
+def run_inspect(run_dir) -> dict:
+    """``qfedx inspect <run-dir>``: the read side of the run directory.
 
-    return jax.profiler.trace(str(log_dir))
+    Summarizes ``metrics.jsonl`` (rounds completed, loss/accuracy
+    trajectory, the casualty/byzantine/staleness ledger totals, schema
+    validation of every row via ``validate_metrics_record``),
+    ``summary.json``, and ``profile_summary.json`` when a profiled run
+    left one. Prints a compact report plus one final JSON line; returns
+    the dict."""
+    from qfedx_tpu.run.metrics import validate_metrics_record
+    from qfedx_tpu.utils.host import is_primary
+
+    say = print if is_primary() else (lambda *a, **k: None)
+    run_dir = Path(run_dir)
+    metrics_path = run_dir / "metrics.jsonl"
+    if not metrics_path.exists():
+        raise FileNotFoundError(
+            f"{metrics_path} not found — not a tracked run directory"
+        )
+
+    rows, invalid = [], []
+    for i, line in enumerate(metrics_path.read_text().splitlines()):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as exc:
+            invalid.append(f"line {i + 1}: bad JSON: {exc}")
+            continue
+        try:
+            rows.append(validate_metrics_record(rec))
+        except ValueError as exc:
+            invalid.append(f"line {i + 1}: {exc}")
+            # Schema violations are REPORTED, not fatal: a pre-schema
+            # run still summarizes from whatever rounds it recorded.
+            if isinstance(rec.get("round"), int):
+                rows.append(rec)
+
+    accs = [r["accuracy"] for r in rows if r.get("accuracy") is not None]
+    losses = [r["loss"] for r in rows if r.get("loss") is not None]
+    # The permanent robustness record (r11–r13 ledgers) — summed only
+    # over rows that carry the field, so pre-guard runs report nothing.
+    ledger = {
+        field: int(sum(r[field] for r in rows if field in r))
+        for field in (
+            "rejected_updates", "dropped_clients", "clipped_clients",
+            "late_waves", "stale_partials_applied", "stale_discarded_waves",
+        )
+        if any(field in r for r in rows)
+    }
+    out = {
+        "run_dir": str(run_dir),
+        "rounds_completed": max((r["round"] for r in rows), default=0),
+        "metrics_rows": len(rows),
+        "invalid_rows": len(invalid),
+        "first_accuracy": accs[0] if accs else None,
+        "best_accuracy": max(accs) if accs else None,
+        "last_accuracy": accs[-1] if accs else None,
+        "last_loss": losses[-1] if losses else None,
+        "last_epsilon": next(
+            (r["epsilon"] for r in reversed(rows) if r.get("epsilon")
+             is not None),
+            None,
+        ),
+        "rounds_skipped": sum(1 for r in rows if r.get("skipped")),
+        "ledger": ledger,
+    }
+    # Artifact problems are tracked apart from metrics-row validation:
+    # invalid_rows (already in `out`) counts metrics.jsonl records only,
+    # and a truncated summary.json must still show up in the JSON line.
+    bad_artifacts = []
+    for name in ("summary.json", "profile_summary.json", "config.json"):
+        path = run_dir / name
+        if path.exists():
+            try:
+                obj = json.loads(path.read_text())
+            except ValueError:
+                bad_artifacts.append(name)
+                continue
+            if name == "summary.json":
+                out["summary"] = {
+                    k: obj.get(k)
+                    for k in ("final_accuracy", "final_epsilon",
+                              "wall_time_s", "partial", "crashed")
+                    if k in obj
+                }
+            elif name == "profile_summary.json":
+                out["profile"] = {
+                    k: obj.get(k)
+                    for k in ("ops_executed", "gap_p50_us",
+                              "device_busy_fraction", "device_busy_s")
+                }
+            else:
+                model = (obj.get("model") or {})
+                out["model"] = (
+                    f"{model.get('model', '?')} "
+                    f"n={model.get('n_qubits', '?')} "
+                    f"layers={model.get('n_layers', '?')}"
+                )
+    if bad_artifacts:
+        out["unreadable_artifacts"] = bad_artifacts
+    say(f"[qfedx_tpu] {run_dir}: {out['rounds_completed']} rounds, "
+        f"accuracy {out['first_accuracy']} -> {out['last_accuracy']} "
+        f"(best {out['best_accuracy']})")
+    if ledger:
+        say("[qfedx_tpu] ledger: " + json.dumps(ledger))
+    for problem in invalid[:5]:
+        say(f"[qfedx_tpu] invalid metrics record: {problem}")
+    for name in bad_artifacts:
+        say(f"[qfedx_tpu] unreadable artifact: {name}")
+    say("[qfedx_tpu] " + json.dumps(out))
+    return out
 
 
 def main(argv=None):
@@ -574,6 +749,8 @@ def main(argv=None):
                   profile=args.profile, trace=args.trace)
     elif args.cmd == "serve":
         run_serve(args)
+    elif args.cmd == "inspect":
+        run_inspect(args.run_dir)
     elif args.cmd == "demo":
         from qfedx_tpu.run.demo import run_demo
 

@@ -32,6 +32,7 @@ def test_every_known_pin_family_member_is_seen():
     for name in (
         "QFEDX_DTYPE", "QFEDX_FOLD_CLIENTS", "QFEDX_FUSE", "QFEDX_TRACE",
         "QFEDX_PIPELINE", "QFEDX_DONATE", "QFEDX_HIER", "QFEDX_STREAM",
+        "QFEDX_PROFILE",
     ):
         assert name in pins, f"scanner lost {name}"
     assert len(documented_pins()) >= len(pins) - 1
@@ -135,6 +136,51 @@ def test_span_guard_fires_both_directions(tmp_path):
     assert any("stale.span" in p and "stale" in p for p in problems)
     assert not any("documented.span" in p for p in problems)
     assert not any("prose.span" in p for p in problems)
+
+
+# --- the profile_summary schema guard (r16 satellite, same family) -----------
+
+from benchmarks.check_profile import (  # noqa: E402
+    check as check_profile,
+    documented_fields,
+    source_fields,
+)
+
+
+def test_profile_schema_matches_source():
+    assert check_profile() == []
+
+
+def test_profile_schema_scanner_sees_the_known_fields():
+    # An empty parse would make the drift check vacuously pass; the
+    # table must carry at least the fields the floor evidence is
+    # built on (ISSUE r16 acceptance surface).
+    fields = source_fields()
+    for name in (
+        "ops_executed", "gap_p50_us", "device_busy_fraction",
+        "measured_vs_static", "spans",
+    ):
+        assert name in fields, f"SUMMARY_FIELDS lost {name}"
+    assert documented_fields() == fields
+
+
+def test_profile_schema_guard_fires_both_directions(tmp_path):
+    doc = tmp_path / "OBS.md"
+    doc.write_text(
+        "## The `profile_summary.json` schema\n\n"
+        "| field | meaning |\n|---|---|\n"
+        "| `ops_executed` | executed op events |\n"
+        "| `stale_field` | gone |\n"
+    )
+    problems = check_profile(doc)
+    assert any("gap_p50_us" in p for p in problems)  # undocumented field
+    assert any("stale_field" in p and "stale" in p for p in problems)
+    assert not any("'ops_executed'" in p for p in problems)
+    # rows outside the schema section are not schema rows
+    doc.write_text(
+        "## Some other table\n\n| field |\n|---|\n| `ops_executed` |\n"
+    )
+    assert "ops_executed" not in documented_fields(doc)
 
 
 def test_fault_guard_fires_both_directions(tmp_path):

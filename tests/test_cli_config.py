@@ -78,8 +78,9 @@ def test_build_model_kernel_and_noise():
     assert "vqc" in build_model(noisy, 2).name
 
 
-def test_run_train_end_to_end(tmp_path):
+def test_run_train_end_to_end(tmp_path, monkeypatch):
     """The full CLI path: synthetic data → SPMD training → run artifacts."""
+    monkeypatch.delenv("QFEDX_PROFILE", raising=False)
     cfg = parse(
         [
             "train", "--model", "vqc", "--qubits", "3", "--layers", "1",
@@ -97,6 +98,165 @@ def test_run_train_end_to_end(tmp_path):
         json.loads(l) for l in (run_dir / "metrics.jsonl").read_text().splitlines()
     ]
     assert len(metrics) == 2 and metrics[-1]["round"] == 2
+    # Default-off invariance (r16): no --profile flag and QFEDX_PROFILE
+    # unset → no profiler session ran, no capture dir, no summary file.
+    assert not (run_dir / "profile").exists()
+    assert not (run_dir / "profile_summary.json").exists()
+
+
+@pytest.mark.slow
+def test_run_train_profiled_writes_summary_and_device_trace(tmp_path, monkeypatch):
+    """--profile end-to-end (r16): the capture is parsed into
+    profile_summary.json (measured census + gaps + busy fraction), the
+    traced run's trace.json gains the device lane, and summary.json's
+    phase_breakdown carries device_busy_s/utilization columns. Slow:
+    real captures live in the slow tier (the r16 test pattern — the
+    parser math is fixture-pinned fast in tests/test_obs.py)."""
+    monkeypatch.delenv("QFEDX_PROFILE", raising=False)
+    monkeypatch.setenv("QFEDX_TRACE", "1")
+    monkeypatch.delenv("QFEDX_TRACE_XLA", raising=False)
+    # Identical model/fed config to test_run_train_end_to_end above —
+    # the round program is already jitted in this process, so this test
+    # pays capture+parse cost, not a second compile.
+    cfg = parse(
+        [
+            "train", "--model", "vqc", "--qubits", "3", "--layers", "1",
+            "--classes", "0,1", "--clients", "4", "--rounds", "2",
+            "--local-epochs", "1", "--batch-size", "8", "--lr", "0.1",
+            "--optimizer", "adam",
+            "--run-root", str(tmp_path), "--name", "prof",
+        ]
+    )
+    run_train(cfg, profile=True, trace=True)
+    run_dir = tmp_path / "prof"
+    psum = json.loads((run_dir / "profile_summary.json").read_text())
+    from qfedx_tpu.obs.profile import SUMMARY_FIELDS
+
+    assert set(psum) == set(SUMMARY_FIELDS)
+    assert psum["ops_executed"] > 0 and psum["gap_count"] > 0
+    assert psum["device_busy_fraction"] is not None
+    # span correlation reached the rollup: a phase carries device time
+    # within its wall (--profile with --trace auto-bridges the spans)
+    assert psum["spans"], "no annotation ranges correlated"
+    summary = json.loads((run_dir / "summary.json").read_text())
+    rolled = [
+        row for row in summary["phase_breakdown"].values()
+        if "device_busy_s" in row
+    ]
+    assert rolled
+    for row in rolled:
+        assert 0 < row["device_busy_s"] <= row["total_s"] + 1e-9
+        assert 0 < row["utilization"] <= 1.0
+    # the merged trace: host spans (pid 1) + the device lane (pid 1000)
+    trace = json.loads((run_dir / "trace.json").read_text())
+    pids = {e.get("pid") for e in trace["traceEvents"] if e.get("ph") == "X"}
+    assert 1 in pids and 1000 in pids
+    # capture artifacts live under <run-dir>/profile
+    from qfedx_tpu.obs.profile import find_capture
+
+    assert find_capture(run_dir / "profile") is not None
+
+
+@pytest.mark.slow
+def test_run_train_profiled_killed_midway_keeps_parseable_capture(
+    tmp_path, monkeypatch
+):
+    """The r16 crash-safety satellite: a --profile run killed mid-train
+    (the KeyboardInterrupt SIGTERM translates into) still stops the
+    profiler session, leaves a PARSEABLE capture, and writes
+    profile_summary.json from it — the bare jax.profiler.trace at this
+    seam could leave a torn capture. Slow: real capture (the fast
+    crash-safety unit is tests/test_obs.py::
+    test_profile_capture_crash_safe_and_parseable)."""
+    import qfedx_tpu.run.trainer as trainer_mod
+
+    real = trainer_mod.train_federated
+
+    def die_after_training(*args, **kwargs):
+        real(*args, **kwargs)
+        raise KeyboardInterrupt("SIGTERM")
+
+    monkeypatch.setattr(trainer_mod, "train_federated", die_after_training)
+    monkeypatch.delenv("QFEDX_PROFILE", raising=False)
+    # Same cached program again (see the profiled test above).
+    cfg = parse(
+        [
+            "train", "--model", "vqc", "--qubits", "3", "--layers", "1",
+            "--classes", "0,1", "--clients", "4", "--rounds", "2",
+            "--local-epochs", "1", "--batch-size", "8", "--lr", "0.1",
+            "--optimizer", "adam",
+            "--run-root", str(tmp_path), "--name", "killed",
+        ]
+    )
+    with pytest.raises(KeyboardInterrupt):
+        run_train(cfg, profile=True)
+    run_dir = tmp_path / "killed"
+    from qfedx_tpu.obs.profile import parse_capture
+
+    parsed = parse_capture(run_dir / "profile")
+    assert parsed["ops_executed"] > 0  # the capture survived, parseable
+    psum = json.loads((run_dir / "profile_summary.json").read_text())
+    assert psum["ops_executed"] == parsed["ops_executed"]
+
+
+def test_inspect_run_dir(tmp_path, capsys):
+    """qfedx inspect: the read side of the run directory — trajectory,
+    ledger totals, schema validation, profile summary."""
+    from qfedx_tpu.run.cli import main, run_inspect
+
+    run_dir = tmp_path / "r"
+    run_dir.mkdir()
+    rows = [
+        {"schema": 1, "round": 1, "ts": 1.0, "loss": 0.9, "accuracy": 0.5,
+         "rejected_updates": 1, "late_waves": 2},
+        {"schema": 1, "round": 2, "ts": 2.0, "loss": 0.5, "accuracy": 0.8,
+         "rejected_updates": 0, "late_waves": 1, "epsilon": 2.5},
+        {"round": 3, "ts": 3.0, "loss": 0.4, "accuracy": 0.9},  # no schema
+        "not json at all",
+    ]
+    (run_dir / "metrics.jsonl").write_text(
+        "\n".join(
+            r if isinstance(r, str) else json.dumps(r) for r in rows
+        ) + "\n"
+    )
+    (run_dir / "summary.json").write_text(
+        json.dumps({"final_accuracy": 0.9, "wall_time_s": 12.5})
+    )
+    (run_dir / "profile_summary.json").write_text(
+        json.dumps({"ops_executed": 1200, "gap_p50_us": 3.4,
+                    "device_busy_fraction": 0.97, "device_busy_s": 1.0})
+    )
+    (run_dir / "config.json").write_text(
+        json.dumps({"model": {"model": "vqc", "n_qubits": 8, "n_layers": 2}})
+    )
+    out = run_inspect(run_dir)
+    assert out["rounds_completed"] == 3  # schema-less row still counted
+    assert out["metrics_rows"] == 3
+    assert out["invalid_rows"] == 2  # bad JSON + missing schema field
+    assert out["first_accuracy"] == 0.5 and out["best_accuracy"] == 0.9
+    assert out["last_epsilon"] == 2.5
+    assert out["ledger"] == {"rejected_updates": 1, "late_waves": 3}
+    assert out["summary"]["final_accuracy"] == 0.9
+    assert out["profile"]["gap_p50_us"] == 3.4
+    assert out["model"].startswith("vqc n=8")
+    # the CLI path prints the same dict as its final JSON line
+    capsys.readouterr()
+    main(["inspect", str(run_dir)])
+    last = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(last.split("] ", 1)[1])["rounds_completed"] == 3
+    # a truncated artifact is reported in the JSON line, apart from the
+    # metrics-row validation count
+    (run_dir / "summary.json").write_text('{"final_accuracy": 0.')
+    out = run_inspect(run_dir)
+    assert out["unreadable_artifacts"] == ["summary.json"]
+    assert out["invalid_rows"] == 2  # metrics rows only, unchanged
+
+
+def test_inspect_missing_run_dir_is_loud(tmp_path):
+    from qfedx_tpu.run.cli import run_inspect
+
+    with pytest.raises(FileNotFoundError, match="metrics.jsonl"):
+        run_inspect(tmp_path)
 
 
 def test_spsa_trains():

@@ -723,6 +723,339 @@ def test_killed_run_flushes_partial_trace_and_rollup(traced, tmp_path):
     assert json.loads((run.dir / "summary.json").read_text()) == {"final": 1}
 
 
+# --- device-timeline profiling (r16 tentpole) --------------------------------
+
+from qfedx_tpu.obs import profile as obs_profile  # noqa: E402
+
+
+def _profile_fixture_events():
+    """A small checked-in Perfetto/trace-event capture with known math:
+    one device lane (hlo_op-tagged ops, one nested child), one host
+    annotation lane (the QFEDX_TRACE_XLA bridge's mirror of
+    ``round.dispatch``), and python-profiler noise the parser must
+    ignore. Intervals in µs:
+
+      matmul.1  [100, 1100)            top-level
+      fusion.2  [1103, 2100)  gap 3    top-level
+      child.4   [1200, 1300)           NESTED inside fusion.2
+      fusion.2  [2110, 3100)  gap 10   top-level
+    """
+    dev = {"pid": 7, "tid": 70}
+    return [
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "name": "matmul.1", "ts": 100.0, "dur": 1000.0,
+         "args": {"hlo_module": "jit_f", "hlo_op": "matmul.1"}, **dev},
+        {"ph": "X", "name": "fusion.2", "ts": 1103.0, "dur": 997.0,
+         "args": {"hlo_module": "jit_f", "hlo_op": "fusion.2"}, **dev},
+        {"ph": "X", "name": "child.4", "ts": 1200.0, "dur": 100.0,
+         "args": {"hlo_module": "jit_f", "hlo_op": "child.4"}, **dev},
+        {"ph": "X", "name": "fusion.2", "ts": 2110.0, "dur": 990.0,
+         "args": {"hlo_module": "jit_f", "hlo_op": "fusion.2"}, **dev},
+        # the annotation lane: a host thread, no hlo_op args
+        {"ph": "X", "name": "round.dispatch", "ts": 50.0, "dur": 3100.0,
+         "pid": 7, "tid": 11},
+        # python-profiler noise: not an op, not an annotation
+        {"ph": "X", "name": "$profiler.py:91 start_trace", "ts": 0.0,
+         "dur": 3200.0, "pid": 7, "tid": 11},
+    ]
+
+
+def test_profile_parse_op_census_total_and_self_time():
+    parsed = obs_profile.parse_events(_profile_fixture_events())
+    # executed SLOTS: the nested child folds into its parent — the
+    # count shares one slot definition with the gap/busy census
+    assert parsed["ops_executed"] == 3
+    assert parsed["ops_distinct"] == 2  # matmul.1, fusion.2
+    census = parsed["census"]
+    assert set(census) == {"matmul", "fusion", "child"}
+    assert census["matmul"] == {
+        "count": 1, "total_us": 1000.0, "self_us": 1000.0
+    }
+    # the two fusion.2 instances group under one base name; the nested
+    # child's 100 µs is subtracted from its parent's SELF time only
+    assert census["fusion"]["count"] == 2
+    assert census["fusion"]["total_us"] == pytest.approx(1987.0)
+    assert census["fusion"]["self_us"] == pytest.approx(1887.0)
+    assert census["child"]["self_us"] == pytest.approx(100.0)
+
+
+def test_profile_parse_gaps_busy_and_lanes():
+    parsed = obs_profile.parse_events(_profile_fixture_events())
+    # top-level intervals only: busy 1000+997+990 over window [100,3100)
+    assert parsed["device_lanes"] == 1
+    assert parsed["busy_us"] == pytest.approx(2987.0)
+    assert parsed["window_us"] == pytest.approx(3000.0)
+    # gaps 3 and 10 µs — the nested child opens NO gap
+    h = parsed["gap_hist"]
+    assert h.count == 2
+    assert parsed["gap_sum_us"] == pytest.approx(13.0)
+    summary = obs_profile.summarize(parsed, static_state_ops=3, steps=1)
+    # bounded-histogram quantiles: lower bucket edge, never above exact
+    lo3, hi3 = obs.Histogram.bucket_bounds(3.0)
+    assert summary["gap_p50_us"] == pytest.approx(lo3, abs=1e-3)
+    assert lo3 <= 3.0 < hi3
+    assert summary["gap_p95_us"] == pytest.approx(10.0, rel=0.11)
+    assert summary["gap_p95_us"] <= 10.0 + 1e-6
+    assert summary["gap_mean_us"] == pytest.approx(6.5)
+    assert summary["device_busy_fraction"] == pytest.approx(
+        2987.0 / 3000.0, abs=1e-3
+    )
+    # 3 top-level slots / 1 step vs a static census of 3: exact
+    # agreement — ops x gap prices the floor over ONE slot definition
+    assert summary["ops_per_step"] == 3.0
+    assert summary["measured_vs_static"] == pytest.approx(1.0, abs=1e-3)
+    assert summary["schema"] == obs_profile.PROFILE_SUMMARY_SCHEMA_VERSION
+
+
+def test_profile_summary_fields_match_contract():
+    """summarize() emits EXACTLY the SUMMARY_FIELDS keys — the schema
+    the docs table and check_profile.py guard."""
+    parsed = obs_profile.parse_events(_profile_fixture_events())
+    summary = obs_profile.summarize(parsed)
+    assert set(summary) == set(obs_profile.SUMMARY_FIELDS)
+    # and with every optional input supplied, still the same keys
+    summary = obs_profile.summarize(parsed, static_state_ops=9, steps=2)
+    assert set(summary) == set(obs_profile.SUMMARY_FIELDS)
+
+
+def test_profile_span_correlation_and_rollup_columns(traced):
+    """Span correlation: the annotation range's device overlap becomes
+    per-span device_busy_s/utilization, and phase_rollup rows carry
+    the columns with device_busy_s <= wall and utilization in (0,1]."""
+    with obs.span("round.dispatch", round=1):
+        pass
+    parsed = obs_profile.parse_events(
+        _profile_fixture_events(), span_names={"round.dispatch"}
+    )
+    ann = parsed["annotations"]["round.dispatch"]
+    assert ann["count"] == 1
+    assert ann["wall_us"] == pytest.approx(3100.0)
+    assert ann["busy_us"] == pytest.approx(2987.0)  # top-level overlap
+    summary = obs_profile.summarize(parsed)
+    row = summary["spans"]["round.dispatch"]
+    assert row["device_busy_s"] == pytest.approx(2987e-6)
+    assert row["utilization"] == pytest.approx(2987.0 / 3100.0, abs=1e-3)
+    obs_profile.attach_span_device(summary)
+    roll = obs.phase_rollup()["round.dispatch"]
+    # the real registry span is ~µs long; the clamp keeps the invariant
+    assert 0 < roll["device_busy_s"] <= roll["total_s"]
+    assert 0 < roll["utilization"] <= 1.0
+
+
+def test_profile_device_pid_fallback_detector():
+    """Backends that drop hlo_op args: every X event on a device-named
+    pid is an op event (the TPU-lane fallback)."""
+    events = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "python"}},
+        {"ph": "X", "name": "fusion.9", "ts": 0.0, "dur": 5.0,
+         "pid": 3, "tid": 1},
+        {"ph": "X", "name": "fusion.9", "ts": 9.0, "dur": 5.0,
+         "pid": 3, "tid": 1},
+        {"ph": "X", "name": "host_thing", "ts": 0.0, "dur": 50.0,
+         "pid": 1, "tid": 1},
+    ]
+    parsed = obs_profile.parse_events(events)
+    assert parsed["ops_executed"] == 2
+    assert parsed["gap_hist"].count == 1  # one 4 µs gap, host ignored
+
+
+def test_profile_merged_trace_aligns_device_lane(traced, tmp_path):
+    """The merged Perfetto file: host spans and the device lane share
+    one time origin — the k-th annotation of a name anchors to the k-th
+    registry span of that name."""
+    with obs.span("round.dispatch", round=1):
+        pass
+    sp = obs.registry().spans[-1]
+    t0_rel_us = (sp.t0 - obs.registry().origin) * 1e6
+    parsed = obs_profile.parse_events(
+        _profile_fixture_events(), span_names={"round.dispatch"}
+    )
+    offset = obs_profile.align_offset_us(parsed)
+    assert offset == pytest.approx(t0_rel_us - 50.0, abs=1e-3)
+    path = obs_profile.write_merged_trace(tmp_path / "merged.json", parsed)
+    obj = json.loads(path.read_text())
+    xs = [e for e in obj["traceEvents"] if e.get("ph") == "X"]
+    host = [e for e in xs if e["name"] == "round.dispatch"]
+    dev = [e for e in xs if e["pid"] == 1000]
+    # the device lane carries the 3 TOP-LEVEL scheduling slots; the
+    # nested child is an op's internal decomposition, not a slot
+    assert host and len(dev) == 3
+    lanes = {
+        e["pid"]: e["args"]["name"]
+        for e in obj["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert lanes[1000] == "qfedx device"
+    # shared origin: the fixture's first op starts 50 µs after the
+    # annotation, i.e. at the registry span's t0 + 50 on the merged axis
+    first_dev = min(e["ts"] for e in dev)
+    assert first_dev == pytest.approx(t0_rel_us + 50.0, abs=1.0)
+
+
+def test_profile_meta_anchor_fallback_alignment(tmp_path):
+    """Without annotations the capture_meta.json start anchor rebases
+    the lane (~ms accuracy) instead of leaving it unaligned."""
+    obs.reset()
+    events = [e for e in _profile_fixture_events()
+              if e["name"] != "round.dispatch"]
+    parsed = obs_profile.parse_events(events)
+    parsed["capture_meta"] = {"start_rel_origin_us": 5000.0}
+    offset = obs_profile.align_offset_us(parsed)
+    assert offset == pytest.approx(5000.0 - parsed["t_min_us"])
+    parsed2 = obs_profile.parse_events(events)
+    assert obs_profile.align_offset_us(parsed2) is None  # neither anchor
+
+
+def test_profile_pin_grammar(monkeypatch):
+    monkeypatch.delenv("QFEDX_PROFILE", raising=False)
+    assert obs_profile.profile_dir("/d") is None  # unset = off
+    for v in ("0", "off"):
+        monkeypatch.setenv("QFEDX_PROFILE", v)
+        assert obs_profile.profile_dir("/d") is None
+    for v in ("1", "on"):
+        monkeypatch.setenv("QFEDX_PROFILE", v)
+        assert obs_profile.profile_dir("/d") == "/d"
+    monkeypatch.setenv("QFEDX_PROFILE", "./captures")
+    assert obs_profile.profile_dir("/d") == "./captures"
+    monkeypatch.setenv("QFEDX_PROFILE", "yes")
+    with pytest.raises(ValueError, match="QFEDX_PROFILE"):
+        obs_profile.profile_dir("/d")
+
+
+def test_profile_capture_crash_safe_and_parseable(tmp_path):
+    """A capture killed by an exception mid-region (the unwind SIGTERM
+    takes through the utils/host translation) still stops the profiler
+    session and leaves a PARSEABLE capture of the executed ops — the
+    torn-capture failure mode of the bare jax.profiler.trace context
+    this replaced."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return jnp.sin(x) @ jnp.cos(x).T
+
+    x = jnp.ones((64, 64))
+    f(x).block_until_ready()  # compile outside the capture
+    with pytest.raises(KeyboardInterrupt):
+        with obs_profile.capture(tmp_path / "prof"):
+            f(x).block_until_ready()
+            raise KeyboardInterrupt("SIGTERM")
+    parsed = obs_profile.parse_capture(tmp_path / "prof")
+    assert parsed["ops_executed"] > 0
+    assert parsed["capture_meta"]["start_rel_origin_us"] > 0
+    # the one-call API parses the same capture and writes the artifact
+    summary = obs_profile.write_profile_summary(
+        tmp_path, capture_dir=tmp_path / "prof"
+    )
+    assert set(summary) == set(obs_profile.SUMMARY_FIELDS)
+    assert json.loads(
+        (tmp_path / "profile_summary.json").read_text()
+    ) == summary
+    # and a second capture works (the session was really stopped)
+    with obs_profile.capture(tmp_path / "prof2"):
+        f(x).block_until_ready()
+    assert obs_profile.find_capture(tmp_path / "prof2") is not None
+
+
+def test_profile_parse_without_capture_is_loud(tmp_path):
+    with pytest.raises(FileNotFoundError, match="capture"):
+        obs_profile.parse_capture(tmp_path)
+
+
+@pytest.mark.slow
+def test_profile_real_capture_end_to_end(traced, tmp_path, monkeypatch):
+    """A real CPU capture around a real (tiny) federated round: the
+    summary's fields exist, span correlation attributes device time to
+    round.dispatch with utilization in (0,1], device_busy_s <= wall in
+    the rollup, and the merged Perfetto file carries host + device
+    lanes on one origin."""
+    monkeypatch.setenv("QFEDX_TRACE_XLA", "1")
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+    from qfedx_tpu.run.trainer import train_federated
+
+    model = make_vqc_classifier(n_qubits=2, n_layers=1, num_classes=2)
+    rng = np.random.default_rng(0)
+    cx = rng.uniform(0, 1, (4, 8, 2)).astype(np.float32)
+    cy = rng.integers(0, 2, (4, 8)).astype(np.int32)
+    cm = np.ones((4, 8), dtype=np.float32)
+    tx = rng.uniform(0, 1, (16, 2)).astype(np.float32)
+    ty = rng.integers(0, 2, 16).astype(np.int32)
+    cfg = FedConfig(local_epochs=1, batch_size=4, learning_rate=0.1)
+
+    with obs_profile.capture(tmp_path / "prof"):
+        train_federated(
+            model, cfg, cx, cy, cm, tx, ty, num_rounds=2, pipeline_depth=0,
+        )
+    parsed = obs_profile.parse_capture(tmp_path / "prof")
+    summary = obs_profile.summarize(parsed)
+    assert set(summary) == set(obs_profile.SUMMARY_FIELDS)
+    assert summary["ops_executed"] > 0
+    assert summary["device_lanes"] >= 1
+    assert summary["gap_count"] > 0
+    # SOME phase carries real device time (which one depends on where
+    # the async dispatch's execution lands — dispatch vs fetch vs eval)
+    assert summary["spans"], "no annotation ranges correlated"
+    for row in summary["spans"].values():
+        assert 0 < row["utilization"] <= 1.0
+    obs_profile.attach_span_device(summary)
+    roll = obs.phase_rollup()
+    attributed = [r for r in roll.values() if "device_busy_s" in r]
+    assert attributed
+    for r in attributed:
+        assert 0 < r["device_busy_s"] <= r["total_s"] + 1e-9
+        assert 0 < r["utilization"] <= 1.0
+    path = obs_profile.write_merged_trace(tmp_path / "merged.json", parsed)
+    obj = json.loads(path.read_text())
+    pids = {e.get("pid") for e in obj["traceEvents"] if e.get("ph") == "X"}
+    assert 1 in pids and 1000 in pids  # host spans + the device lane
+
+
+@pytest.mark.slow
+def test_profile_dense18q_measured_census_loose_pin(tmp_path):
+    """The ISSUE r16 acceptance pin, LOOSE form (exact numbers are
+    recorded in docs/PERF.md §16): a profiled dense18q step on this
+    container yields a measured census comparable to the static
+    obs/hlo.py census and a µs-scale per-op gap. On XLA:CPU the
+    executed-thunk count runs BELOW the lowered census at this width
+    (the backend's own fusion merges state passes — the §16 correction
+    to the §15 census-÷-wall inference), so the band is wide on the low
+    side; the agreement tightens to <10% at n=12 (also §16)."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from benchmarks._util import build_step, device_sync
+    from qfedx_tpu.obs.hlo import lowered_state_ops
+
+    fn, params, steps = build_step(18, 3, 16, 1)
+    static = lowered_state_ops(fn, params, 18)
+    assert static > 2000  # the ~3k state-op program §15 priced
+    params, ls = fn(params)
+    device_sync(ls)
+    with obs_profile.capture(tmp_path / "prof"):
+        params, ls = fn(params)
+        device_sync(params)
+    parsed = obs_profile.parse_capture(tmp_path / "prof")
+    summary = obs_profile.summarize(
+        parsed, static_state_ops=static, steps=steps
+    )
+    # loose: measured within [0.5, 1.1] of static (measured 0.61 on
+    # this container, within 10% on-chip per the §15 model; PERF §16)
+    assert 0.5 <= summary["measured_vs_static"] <= 1.1, summary
+    # µs-scale per-op gap: the §15 band is 3–5 µs on-chip; this
+    # container's CPU thunk gaps measured ~12 µs at this width (§16)
+    assert 0.3 <= summary["gap_p50_us"] <= 50.0, summary
+    assert summary["device_busy_fraction"] > 0.5
+
+
 def test_fuse_counters_via_engine(traced, monkeypatch):
     """The fusion pass reports trace-time op counts when it runs."""
     import jax
