@@ -77,13 +77,21 @@ Sections in ``bench_details.json`` (beyond the headline):
 - ``dense18q_bf16_scan16``: the r14 floor lever — the dense18q_bf16 step
   at scan depth 16 vs 4, reading the dispatch-gap share of the §11
   dtype-invariant floor directly (docs/PERF.md §15).
-- ``floor_attribution`` (r16, compact copy on the printed line): the
+- ``fed16q_bf16_scan_off``: the r17 scan-over-fused-layers lever — the
+  same composed row with QFEDX_SCAN_LAYERS=off (the r07 per-layer fused
+  program bit-for-bit); the default row's ``scan_speedup_vs_off`` is the
+  measured end-to-end value of the op-count collapse.
+- ``floor_attribution`` (r16/r17, compact copy on the printed line): the
   MEASURED floor — a profiler capture of the step program parsed by
   ``obs/profile.py`` into executed ops vs the static ``fusion_hlo``
   census, the measured inter-op gap quantiles (the §15 3–5 µs/op
   inference, now measured), and device-busy fraction; ``vs_prev``
   tracks ``gap_us_per_op`` / ``ops_per_step`` — the evidence harness
   every op-count-collapse PR is judged against (docs/PERF.md §16).
+  Since r17 the headline row profiles the scanned program head-to-head
+  with the r07-fused one (``ops_per_step_vs_fused``), plus a ``depth6``
+  L=6 pair: the scanned body is depth-invariant, so the collapse
+  factor rises with L and the L=3 headline is its floor (§17).
 - ``time_to_target`` / ``time_to_target_20q``: wall-clock to target
   accuracy, flagship 8q config and the TRUE 20-qubit config-5 width
   (VERDICT r04 missing 1: 20q had been timed but never trained).
@@ -425,7 +433,7 @@ def _bench_fed16q(jax, rounds_per_call=10, reps=3):
         jax, model, cfg, mesh, num_clients, (cx, cy, cm),
         shard_client_data, rounds_per_call=rounds_per_call, reps=reps,
     )
-    from qfedx_tpu.ops.fuse import fuse_active
+    from qfedx_tpu.ops.fuse import fuse_active, scan_active
 
     return {
         "n_qubits": n_qubits,
@@ -436,6 +444,7 @@ def _bench_fed16q(jax, rounds_per_call=10, reps=3):
         "rounds_per_call": rounds_per_call,
         "fold_clients": fold_clients_enabled(model, cfg),
         "fuse": fuse_active(n_qubits),
+        "scan_layers": scan_active(n_qubits, n_layers),
         "round_s": round(per_round, 5),
         "client_rounds_per_s": round(num_clients / per_round, 2),
         # per local step per client — directly comparable to the bare
@@ -1027,6 +1036,13 @@ def _bench_serve(jax, n_qubits=16, n_layers=3, requests_per_rate=384):
             # definitional shift, not a real latency change.
             "quantile_definition": "histogram lower-edge (r15)",
             "warmup": warm["buckets"],
+            # r17: the engine routing the warmed programs resolved to
+            # (ServeEngine.warmup) — the serve floor row states which
+            # program (scanned or per-layer) it re-reports even when
+            # every raw pin is unset, plus the raw env snapshot for
+            # exact repro.
+            "route": warm.get("route_resolved"),
+            "route_pins": warm.get("route"),
             "batch_s_max_bucket": round(batch_s, 5),
             "capacity_rps": round(capacity, 1),
             "rates": rates,
@@ -1080,18 +1096,25 @@ def _bench_fusion_hlo(jax):
 
 
 def _bench_floor_attribution(jax):
-    """The MEASURED floor evidence (r16; docs/PERF.md §16): a profiler
-    capture of the real step program, parsed into the runtime op census
-    (obs/profile.py) — executed ops vs the static ``fusion_hlo`` census
-    (same ``obs.hlo.lowered_state_ops`` helper), the measured inter-op
-    gap quantiles the §15 3–5 µs/op inference guessed at, and the
-    device-busy fraction. This is the before/after harness every
-    op-count-collapse PR (scan-over-fused-layers, Pallas) is judged
-    against; ``vs_prev`` tracks gap_us_per_op and ops_per_step.
+    """The MEASURED floor evidence (r16; docs/PERF.md §16–17): a
+    profiler capture of the real step program, parsed into the runtime
+    op census (obs/profile.py) — executed ops vs the static
+    ``fusion_hlo`` census (same ``obs.hlo.lowered_state_ops`` helper),
+    the measured inter-op gap quantiles the §15 3–5 µs/op inference
+    guessed at, and the device-busy fraction. Since r17 the headline
+    row profiles the SCANNED program (QFEDX_SCAN_LAYERS — the op-count
+    collapse this harness was built to judge) with the r07-fused
+    program captured head-to-head: ``ops_per_step_vs_fused`` is the
+    measured collapse factor, ``vs_prev`` keeps tracking
+    gap_us_per_op/ops_per_step on the headline row, and the ``depth6``
+    pair measures the same collapse at L=6 (always n=12), where the
+    depth-invariant scanned body pulls further ahead of the
+    linearly-growing r07 program (docs/PERF.md §17).
 
     Width is backend-sized: the chip profiles the dense18q production
-    step; this container's CPU profiles n=12 (a dense18q CPU step is
-    ~30 s of thunks — same math, recorded once in PERF.md §16)."""
+    step; this container's CPU profiles n=12 with the TPU slab routing
+    pinned (a dense18q CPU step is ~30 s of thunks — same math,
+    recorded once in PERF.md §16)."""
     import tempfile
 
     from benchmarks._util import build_step, device_sync
@@ -1100,19 +1123,74 @@ def _bench_floor_attribution(jax):
 
     on_chip = jax.default_backend() == "tpu"
     n, batch, steps = (18, 16, 4) if on_chip else (12, 16, 2)
-    fn, params, _ = build_step(n, 3, batch, steps)
-    static = lowered_state_ops(fn, params, n)
-    params, ls = fn(params)  # warm: compile outside the capture
-    device_sync(ls)
-    with tempfile.TemporaryDirectory(prefix="qfedx-floor-") as tdir:
-        with obs_profile.capture(tdir):
-            params, ls = fn(params)
-            device_sync(params)
-        parsed = obs_profile.parse_capture(tdir)
-    summary = obs_profile.summarize(
-        parsed, static_state_ops=static, steps=steps
+    route = {"QFEDX_FUSE": "1", "QFEDX_SCAN_LAYERS": "1"}
+    if not on_chip:
+        # Off-chip the production slab route must be pinned explicitly
+        # (on the chip these ARE the defaults, so the pins are no-ops).
+        route.update({
+            "QFEDX_GATE_FORM": "flip",
+            "QFEDX_SLAB_LANES": "matmul",
+            "QFEDX_BATCHED": "1",
+        })
+
+    def profile_one(n_layers=3, n_q=None):
+        nq = n if n_q is None else n_q
+        fn, params, _ = build_step(nq, n_layers, batch, steps)
+        static = lowered_state_ops(fn, params, nq)
+        params, ls = fn(params)  # warm: compile outside the capture
+        device_sync(ls)
+        with tempfile.TemporaryDirectory(prefix="qfedx-floor-") as tdir:
+            with obs_profile.capture(tdir):
+                params, ls = fn(params)
+                device_sync(params)
+            parsed = obs_profile.parse_capture(tdir)
+        summary = obs_profile.summarize(
+            parsed, static_state_ops=static, steps=steps
+        )
+        return obs_profile.floor_attribution(static, summary)
+
+    row = _with_env(route, profile_one)
+    fused = _with_env(
+        {**route, "QFEDX_SCAN_LAYERS": "off"}, profile_one
     )
-    row = obs_profile.floor_attribution(static, summary)
+    row["route"] = "scanned"
+    row["r07_fused"] = {
+        k: fused.get(k)
+        for k in ("static_state_ops", "ops_per_step", "gap_us_per_op",
+                  "gap_p95_us", "device_busy_fraction")
+    }
+    if row.get("ops_per_step") and fused.get("ops_per_step"):
+        row["ops_per_step_vs_fused"] = round(
+            fused["ops_per_step"] / row["ops_per_step"], 2
+        )
+    if row.get("static_state_ops") and fused.get("static_state_ops"):
+        row["static_vs_fused"] = round(
+            fused["static_state_ops"] / row["static_state_ops"], 2
+        )
+    # Depth scaling (r17): the scanned body appears ONCE in the lowered
+    # program whatever the depth, while the r07 program repeats every
+    # super-gate per layer — so the collapse factor RISES with L and the
+    # L=3 headline (the repo's flagship depth, kept for vs_prev
+    # continuity) is its floor. The L=6 head-to-head pair measures the
+    # depth dimension on the same harness; always n=12 so the number is
+    # backend-stable (an unrolled deep fused program at chip widths is
+    # minutes of XLA compile for a census no different from n=12's).
+    deep = _with_env(route, lambda: profile_one(6, n_q=12))
+    deep_fused = _with_env(
+        {**route, "QFEDX_SCAN_LAYERS": "off"},
+        lambda: profile_one(6, n_q=12),
+    )
+    row["depth6"] = {
+        "n": 12,
+        "ops_per_step": deep.get("ops_per_step"),
+        "static_state_ops": deep.get("static_state_ops"),
+        "r07_ops_per_step": deep_fused.get("ops_per_step"),
+        "r07_static_state_ops": deep_fused.get("static_state_ops"),
+    }
+    if deep.get("ops_per_step") and deep_fused.get("ops_per_step"):
+        row["depth6"]["ops_per_step_vs_fused"] = round(
+            deep_fused["ops_per_step"] / deep["ops_per_step"], 2
+        )
     row["n"] = n
     row["batch"] = batch
     row["steps"] = steps
@@ -1449,6 +1527,25 @@ def main():
             / fed16_bf16_fuse_off["client_rounds_per_s"],
             3,
         )
+    # The r17 scan lever on the same composed row (QFEDX_SCAN_LAYERS=off
+    # pins the r07 per-layer fused program bit-for-bit): keeps the
+    # scan-over-fused-layers op-count collapse measured head-to-head in
+    # client-rounds/s, like the fuse/fold levers above.
+    fed16_bf16_scan_off = safe(
+        lambda j: _with_env(
+            {"QFEDX_DTYPE": "bf16", "QFEDX_SCAN_LAYERS": "off"},
+            _bench_fed16q, j,
+        )
+    )
+    if (
+        fed16_bf16.get("scan_layers") is True
+        and "client_rounds_per_s" in fed16_bf16_scan_off
+    ):
+        fed16_bf16["scan_speedup_vs_off"] = round(
+            fed16_bf16["client_rounds_per_s"]
+            / fed16_bf16_scan_off["client_rounds_per_s"],
+            3,
+        )
     # The r09 pipeline lever, END-TO-END through the trainer (the rows
     # above time bare dispatches and cannot see the host work the
     # pipeline overlaps): default loop vs QFEDX_PIPELINE=0 head-to-head,
@@ -1764,6 +1861,7 @@ def main():
         "fed16q_bf16": fed16_bf16,
         "fed16q_bf16_unfolded": fed16_bf16_unfolded,
         "fed16q_bf16_fuse_off": fed16_bf16_fuse_off,
+        "fed16q_bf16_scan_off": fed16_bf16_scan_off,
         "fed16q_bf16_pipeline": fed16_bf16_pipeline,
         "fed16q_bf16_pipeline_off": fed16_bf16_pipeline_off,
         "fed16q_bf16_guards_off": fed16_bf16_guards_off,
@@ -1828,6 +1926,11 @@ def main():
                         "client_rounds_per_s"
                     ),
                     "bf16_fuse_off": fed16_bf16_fuse_off.get(
+                        "client_rounds_per_s"
+                    ),
+                    # r17 lever: the same composed row with the scan
+                    # route pinned off (the r07 per-layer program).
+                    "bf16_scan_off": fed16_bf16_scan_off.get(
                         "client_rounds_per_s"
                     ),
                     # Trainer-path pair (r09): NOT comparable to the raw
@@ -1923,9 +2026,10 @@ def main():
                 "floor_attribution": {
                     k: floor_attr.get(k)
                     for k in (
-                        "n", "ops_per_step", "static_state_ops",
+                        "n", "route", "ops_per_step", "static_state_ops",
                         "measured_vs_static", "gap_us_per_op",
-                        "device_busy_fraction",
+                        "device_busy_fraction", "ops_per_step_vs_fused",
+                        "static_vs_fused", "depth6",
                     )
                 }
                 if "error" not in floor_attr

@@ -62,6 +62,22 @@ def _hea_layer_ops_b(n_qubits: int, rx_angles, rz_angles) -> list:
     return hea_layer_ops(n_qubits, rx_angles, rz_angles)
 
 
+def hea_scan_ops(n_qubits: int, rx_stack, rz_stack) -> list:
+    """Layer-STACKED IR trace of the HEA for the scan route (ops/fuse.py
+    r17): ``rx_stack``/``rz_stack`` carry a leading layer axis — (L, n)
+    shared, (L, C, n) client-folded — so each qubit's rotation coefficient
+    is a (L[,C],2,2) stack and the whole L-layer ansatz is ONE trace
+    consumed by ``fuse.fuse_ops_stacked`` instead of L per-layer traces."""
+    return [
+        fuse.Op(
+            "g1",
+            (q,),
+            gates.rot_zx_batched(rx_stack[..., q], rz_stack[..., q]),
+        )
+        for q in range(n_qubits)
+    ] + _ring_ops(n_qubits)
+
+
 def _hea_layer_ops_cb(n_qubits: int, rx_angles, rz_angles) -> list:
     """Client-folded layer trace: per-client (C,2,2) grouped rotation
     stacks (gates.rot_zx_batched) — the fusion pass composes them into
@@ -132,6 +148,16 @@ def hardware_efficient(
     circuits at 14+ qubits inside device memory.
     """
     n_layers = params["rx"].shape[0]
+    n = state.ndim
+    if not remat and fuse.scan_active(n, n_layers):
+        # Scan-over-fused-layers (ops/fuse.py r17): the L layers share
+        # one fused super-gate body; stacked (L,…) coefficients ride
+        # the scan. remat keeps the per-layer loop (jax.checkpoint has
+        # its own per-layer structure the scan would subsume).
+        ops = hea_scan_ops(n, params["rx"], params["rz"])
+        return fuse.apply_scan(
+            state, n, fuse.fuse_ops_stacked(ops, n, n_layers)
+        )
     layer_fn = ansatz_layer
     if remat:
         layer_fn = jax.checkpoint(ansatz_layer)
@@ -180,6 +206,14 @@ def hardware_efficient_b(state, n_qubits: int, params: dict):
     batched path serves widths where remat measured 5× slower than the
     fitting tape — docs/PERF.md §7)."""
     n_layers = params["rx"].shape[0]
+    if fuse.scan_active(n_qubits, n_layers):
+        ops = hea_scan_ops(n_qubits, params["rx"], params["rz"])
+        return fuse.apply_scan(
+            state,
+            n_qubits,
+            fuse.fuse_ops_stacked(ops, n_qubits, n_layers),
+            batched=True,
+        )
     for layer in range(n_layers):
         state = ansatz_layer_b(
             state, n_qubits, params["rx"][layer], params["rz"][layer]
@@ -216,6 +250,20 @@ def hardware_efficient_cb(state, n_qubits: int, params: dict):
     client axis — {"rx": (C, L, n), "rz": (C, L, n)} — and the state is the
     (C·B, 2^n) client-major slab."""
     n_layers = params["rx"].shape[1]
+    if fuse.scan_active(n_qubits, n_layers):
+        # (C, L, n) → (L, C, n): the layer axis leads the scan stack and
+        # the client axis stays a coefficient group (ops/fuse.py r17).
+        ops = hea_scan_ops(
+            n_qubits,
+            jnp.moveaxis(params["rx"], 0, 1),
+            jnp.moveaxis(params["rz"], 0, 1),
+        )
+        return fuse.apply_scan(
+            state,
+            n_qubits,
+            fuse.fuse_ops_stacked(ops, n_qubits, n_layers),
+            batched=True,
+        )
     for layer in range(n_layers):
         state = ansatz_layer_cb(
             state, n_qubits, params["rx"][:, layer], params["rz"][:, layer]
@@ -232,6 +280,38 @@ def data_reuploading_cb(features, params: dict):
 
     c, b, n_qubits = features.shape
     n_layers = params["rx"].shape[1]
+    if fuse.scan_active(n_qubits, n_layers - 1):
+        # Layer 0 encodes |0…0⟩ directly (no bank) and runs alone; the
+        # remaining L−1 [bank + variational layer] blocks share one
+        # scanned trace: per-sample (L−1, C·B, 2, 2) bank stacks join
+        # per-client (L−1, C, 2, 2) variational stacks (ops/fuse.py r17).
+        ew = jnp.moveaxis(params["enc_w"], 0, 1)  # (L, C, n)
+        eb = jnp.moveaxis(params["enc_b"], 0, 1)
+        angles = (
+            ew[:, :, None, :] * (features * jnp.pi)[None]
+            + eb[:, :, None, :]
+        ).reshape(n_layers, c * b, n_qubits)
+        from qfedx_tpu.ops.batched import bstate_product_tree
+
+        flat0 = angles[0]
+        state = bstate_product_tree(angle_amplitudes(flat0, "ry"))
+        state = ansatz_layer_cb(
+            state, n_qubits, params["rx"][:, 0], params["rz"][:, 0]
+        )
+        ops = [
+            fuse.Op("g1", (q,), gates.ry_batched(angles[1:, :, q]))
+            for q in range(n_qubits)
+        ] + hea_scan_ops(
+            n_qubits,
+            jnp.moveaxis(params["rx"], 0, 1)[1:],
+            jnp.moveaxis(params["rz"], 0, 1)[1:],
+        )
+        return fuse.apply_scan(
+            state,
+            n_qubits,
+            fuse.fuse_ops_stacked(ops, n_qubits, n_layers - 1),
+            batched=True,
+        )
     for layer in range(n_layers):
         angles = (
             params["enc_w"][:, layer][:, None] * (features * jnp.pi)
@@ -268,6 +348,29 @@ def data_reuploading_b(features, params: dict):
     from qfedx_tpu.ops.batched import bstate_product
 
     n_layers, n_qubits = params["rx"].shape
+    if fuse.scan_active(n_qubits, n_layers - 1):
+        # Scan route: layer 0 alone, then ONE [bank + layer] body over
+        # the remaining L−1 layers (per-sample (L−1,B,2,2) bank stacks).
+        angles_all = (
+            params["enc_w"][:, None, :] * (features * jnp.pi)[None]
+            + params["enc_b"][:, None, :]
+        )  # (L, B, n)
+        from qfedx_tpu.ops.batched import bstate_product_tree
+
+        state = bstate_product_tree(angle_amplitudes(angles_all[0], "ry"))
+        state = ansatz_layer_b(
+            state, n_qubits, params["rx"][0], params["rz"][0]
+        )
+        ops = [
+            fuse.Op("g1", (q,), gates.ry_batched(angles_all[1:, :, q]))
+            for q in range(n_qubits)
+        ] + hea_scan_ops(n_qubits, params["rx"][1:], params["rz"][1:])
+        return fuse.apply_scan(
+            state,
+            n_qubits,
+            fuse.fuse_ops_stacked(ops, n_qubits, n_layers - 1),
+            batched=True,
+        )
     for layer in range(n_layers):
         angles = (
             params["enc_w"][layer][None] * (features * jnp.pi)
@@ -328,6 +431,20 @@ def data_reuploading(
         for q in range(n_qubits):
             state = apply_gate(state, gates.ry(angles[q]), q)
         return ansatz_layer(state, rx_l, rz_l)
+
+    if not remat and fuse.scan_active(n_qubits, n_layers - 1):
+        angles_all = (
+            params["enc_w"] * (features * jnp.pi)[None] + params["enc_b"]
+        )  # (L, n)
+        state = product_state(angle_amplitudes(angles_all[0], "ry"))
+        state = ansatz_layer(state, params["rx"][0], params["rz"][0])
+        ops = [
+            fuse.Op("g1", (q,), gates.ry_batched(angles_all[1:, q]))
+            for q in range(n_qubits)
+        ] + hea_scan_ops(n_qubits, params["rx"][1:], params["rz"][1:])
+        return fuse.apply_scan(
+            state, n_qubits, fuse.fuse_ops_stacked(ops, n_qubits, n_layers - 1)
+        )
 
     first_fn, block_fn = ansatz_layer, block
     if remat:

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from qfedx_tpu import obs
+from qfedx_tpu.ops import fuse
 from qfedx_tpu.circuits.ansatz import (
     data_reuploading,
     hardware_efficient,
@@ -129,11 +130,23 @@ def make_vqc_classifier(
     # ansatz functions themselves, so every route here — vmap, batched,
     # client-folded — inherits it; under circuit-level noise the fusion
     # barrier falls at each layer boundary where the Kraus channels act
-    # (noisy_forward_state), never across one.
+    # (noisy_forward_state), never across one. On top of that, the r17
+    # scan route (QFEDX_SCAN_LAYERS, ops/fuse.py scan_active) collapses
+    # the L structurally-identical fused layers into ONE lax.scan
+    # super-gate body — again inside the ansatz functions, so the same
+    # three routes inherit it, and noise-interleaved/remat forwards
+    # keep the per-layer loop (channels are scan barriers).
     # The decision is made lazily at first apply (not at model build)
     # because the auto-route probes the backend platform — doing that at
     # build time would initialize the backend as a side effect, pinning
     # the platform before callers could select one.
+    def _scan_on() -> bool:
+        # The effective scan engagement for THIS model: reupload scans
+        # its L-1 [bank + layer] blocks (layer 0 encodes |0...0> alone),
+        # so its route gates one layer shallower (circuits/ansatz.py).
+        eff = n_layers - 1 if encoding == "reupload" else n_layers
+        return fuse.scan_active(n_qubits, eff)
+
     batched_candidate = noise_model is None and not remat and encoding in (
         "angle", "amplitude", "reupload"
     )
@@ -157,6 +170,7 @@ def make_vqc_classifier(
         from qfedx_tpu.ops.batched import (
             bstate_amplitude,
             bstate_product,
+            bstate_product_tree,
             expect_z_all_b,
         )
         from qfedx_tpu.ops.cpx import state_dtype
@@ -164,7 +178,12 @@ def make_vqc_classifier(
         # obs.span here times the TRACE of the engine program (this code
         # runs under jit tracing; zero entries on hot calls) — the
         # "trace build" phase per engine route.
-        with obs.span("engine.trace", engine="batched", n_qubits=n_qubits):
+        with obs.span(
+            "engine.trace",
+            engine="batched",
+            n_qubits=n_qubits,
+            scan=_scan_on(),
+        ):
             a = params["ansatz"]
             if encoding == "reupload":
                 state = data_reuploading_b(x, a)
@@ -172,9 +191,15 @@ def make_vqc_classifier(
                 if encoding == "amplitude":
                     state = bstate_amplitude(x, state_dtype())
                 else:
-                    state = bstate_product(
-                        angle_amplitudes(x * jnp.pi, basis)
+                    # The scan route pairs with the log-depth product
+                    # state (same value, reassociated); scan-off keeps
+                    # the r07-exact sequential encoder.
+                    enc_fn = (
+                        bstate_product_tree
+                        if _scan_on()
+                        else bstate_product
                     )
+                    state = enc_fn(angle_amplitudes(x * jnp.pi, basis))
                 state = hardware_efficient_b(state, n_qubits, a)
             k = params["readout"]["scale"].shape[0]
             z = expect_z_all_b(state, n_qubits)[:, :k]
@@ -190,7 +215,14 @@ def make_vqc_classifier(
                 return eval_noise.noisy_logits(state, params["readout"], None)
             return z_logits(state, params["readout"])
 
-        with obs.span("engine.trace", engine="vmap", n_qubits=n_qubits):
+        with obs.span(
+            "engine.trace",
+            engine="vmap",
+            n_qubits=n_qubits,
+            # remat keeps the per-layer loop (ansatz fns skip the scan
+            # under jax.checkpoint), so the span must not claim it.
+            scan=_scan_on() and not remat,
+        ):
             return jax.vmap(one)(x)
 
     def _apply_batched_clients(cparams, x):
@@ -205,11 +237,17 @@ def make_vqc_classifier(
         from qfedx_tpu.ops.batched import (
             bstate_amplitude,
             bstate_product,
+            bstate_product_tree,
             expect_z_all_b,
         )
         from qfedx_tpu.ops.cpx import state_dtype
 
-        with obs.span("engine.trace", engine="folded", n_qubits=n_qubits):
+        with obs.span(
+            "engine.trace",
+            engine="folded",
+            n_qubits=n_qubits,
+            scan=_scan_on(),
+        ):
             c, bsz = x.shape[0], x.shape[1]
             a = cparams["ansatz"]
             if encoding == "reupload":
@@ -219,9 +257,12 @@ def make_vqc_classifier(
                 if encoding == "amplitude":
                     state = bstate_amplitude(flat, state_dtype())
                 else:
-                    state = bstate_product(
-                        angle_amplitudes(flat * jnp.pi, basis)
+                    enc_fn = (
+                        bstate_product_tree
+                        if _scan_on()
+                        else bstate_product
                     )
+                    state = enc_fn(angle_amplitudes(flat * jnp.pi, basis))
                 state = hardware_efficient_cb(state, n_qubits, a)
             k = cparams["readout"]["scale"].shape[-1]
             z = expect_z_all_b(state, n_qubits)[:, :k].reshape(c, bsz, k)

@@ -530,16 +530,18 @@ def floor_attribution(static_state_ops: int | None, summary: dict) -> dict:
     """The floor-evidence row bench.py and profile_step.py share: the
     §15 inference (static census ÷ wall) next to the MEASURED per-op
     gap and busy fraction — the before/after harness every op-count-
-    collapse PR is judged against (docs/PERF.md §16)."""
+    collapse PR is judged against (docs/PERF.md §16–17). Tolerant of
+    partial summaries (``qfedx inspect`` reads whatever a run dir
+    holds, including pre-schema artifacts): absent fields are None."""
     return {
         "static_state_ops": static_state_ops,
-        "ops_executed": summary["ops_executed"],
-        "ops_per_step": summary["ops_per_step"],
-        "measured_vs_static": summary["measured_vs_static"],
-        "gap_us_per_op": summary["gap_p50_us"],
-        "gap_p95_us": summary["gap_p95_us"],
-        "device_busy_fraction": summary["device_busy_fraction"],
-        "device_lanes": summary["device_lanes"],
+        "ops_executed": summary.get("ops_executed"),
+        "ops_per_step": summary.get("ops_per_step"),
+        "measured_vs_static": summary.get("measured_vs_static"),
+        "gap_us_per_op": summary.get("gap_p50_us"),
+        "gap_p95_us": summary.get("gap_p95_us"),
+        "device_busy_fraction": summary.get("device_busy_fraction"),
+        "device_lanes": summary.get("device_lanes"),
     }
 
 

@@ -119,6 +119,64 @@ def bstate_product(amps: CArray) -> CArray:
     return state
 
 
+def _outer_flat(a: CArray, b: CArray) -> CArray:
+    """(B,s)·(B,t) → (B,s·t) outer-product rows, complex-shortcutted."""
+
+    def k(x, y):
+        return (x[:, :, None] * y[:, None, :]).reshape(x.shape[0], -1)
+
+    rr = k(a.re, b.re)
+    if a.im is None and b.im is None:
+        return CArray(rr, None)
+    a_im = a.imag_or_zeros()
+    b_im = b.imag_or_zeros()
+    return CArray(rr - k(a_im, b_im), k(a.re, b_im) + k(a_im, b.re))
+
+
+def bstate_product_tree(amps: CArray) -> CArray:
+    """``bstate_product`` in log-depth: qubit factors pair level-wise —
+    (B,k,s) → (B,⌊k/2⌋,s²) is ONE vectorized multiply for every pair at
+    that level — so the n-qubit product state costs ~log₂(n) dispatched
+    ops instead of n−1 sequential outer products. Odd leftovers join a
+    trailing carry (order-preserving: qubit 0 stays the slowest axis).
+    Bit-for-bit it reassociates the product, so the r17 scan route uses
+    it while ``bstate_product`` remains the r07-exact encoder."""
+    b, n, _ = amps.re.shape
+
+    def pair(cur: CArray) -> CArray:
+        # Contiguous pairing — (B,k,s) viewed as (B,k/2,2,s) and split on
+        # the pair axis. Strided x[:, 0::2] slices look equivalent but
+        # their transposes are interior-padded scatters on XLA:CPU.
+        def k(x, y):
+            z = x[..., :, None] * y[..., None, :]
+            return z.reshape(z.shape[0], z.shape[1], -1)
+
+        def halves(s):
+            v = s.reshape(s.shape[0], s.shape[1] // 2, 2, s.shape[2])
+            return v[:, :, 0], v[:, :, 1]
+
+        x_re, y_re = halves(cur.re)
+        rr = k(x_re, y_re)
+        if cur.im is None:
+            return CArray(rr, None)
+        x_im, y_im = halves(cur.im)
+        return CArray(
+            rr - k(x_im, y_im), k(x_re, y_im) + k(x_im, y_re)
+        )
+
+    cur = amps
+    carry: CArray | None = None
+    while cur.re.shape[1] > 1:
+        if cur.re.shape[1] % 2:
+            last = _cmap(cur, lambda s: s[:, -1])
+            # The leftover block precedes every earlier carry.
+            carry = last if carry is None else _outer_flat(last, carry)
+            cur = _cmap(cur, lambda s: s[:, :-1])
+        cur = pair(cur)
+    out = _cmap(cur, lambda s: s[:, 0])
+    return out if carry is None else _outer_flat(out, carry)
+
+
 def bstate_amplitude(x: jnp.ndarray, dtype) -> CArray:
     """ℓ2-normalized amplitudes: (B, 2^n) → real state, uniform fallback
     for all-zero rows (reference qAmplitude.py:17-21), batched."""
@@ -299,6 +357,144 @@ def apply_lane_matrix_b(state: CArray, n: int, mt: CArray) -> CArray:
     groups = _coeff_groups(b, mt, 2)
     mt_re, mt_im = _cast_parts(mt, state.re.dtype)
     return _lane_matmul(state, b, mt_re, mt_im, groups)
+
+
+def apply_row_matrix_b(state: CArray, n: int, mt: CArray) -> CArray:
+    """Composed (…,R,R) row operator on a batched (B, 2^n) slab in one
+    (grouped) matmul — the batched twin of statevector.apply_row_matrix
+    (scan-route row-matrix contraction, ops/fuse.py r17). ``mt``: (R,R)
+    shared or (G,R,R) grouped with G | B (the client-folded path's
+    per-client row matrices)."""
+    if n < _SLAB_MIN:
+        raise ValueError(f"batched engine needs n ≥ {_SLAB_MIN}, got {n}")
+    b = state.re.shape[0]
+    groups = _coeff_groups(b, mt, 2)
+    mt_re, mt_im = _cast_parts(mt, state.re.dtype)
+    shape = state.re.shape
+    r = 1 << (n - _LANE_BITS)
+    if groups is None:
+        def mm(s, m):
+            return jnp.einsum("rs,bsk->brk", m, s.reshape(b, r, _LANES))
+    else:
+        def mm(s, m):
+            return jnp.einsum(
+                "grs,gzsk->gzrk",
+                m,
+                s.reshape(groups, b // groups, r, _LANES),
+            )
+
+    rr = mm(state.re, mt_re)
+    if mt_im is None and state.im is None:
+        return CArray(rr.reshape(shape), None)
+    if mt_im is None:
+        return CArray(rr.reshape(shape), mm(state.im, mt_re).reshape(shape))
+    if state.im is None:
+        return CArray(rr.reshape(shape), mm(state.re, mt_im).reshape(shape))
+    return CArray(
+        (rr - mm(state.im, mt_im)).reshape(shape),
+        (mm(state.im, mt_re) + mm(state.re, mt_im)).reshape(shape),
+    )
+
+
+def apply_row_perm_b(state: CArray, n: int, perm) -> CArray:
+    """Static row-index permutation on the batched slab in one gather —
+    the batched twin of statevector.apply_row_perm (a row-row CNOT chain
+    collapsed; perm indices are trace-time constants, so grouping is
+    irrelevant: every row block permutes identically)."""
+    if n < _SLAB_MIN:
+        raise ValueError(f"batched engine needs n ≥ {_SLAB_MIN}, got {n}")
+    b = state.re.shape[0]
+    shape = state.re.shape
+    idx = jnp.asarray(perm, dtype=jnp.int32)
+    r = 1 << (n - _LANE_BITS)
+
+    def take(s):
+        return s.reshape(b, r, _LANES)[:, idx].reshape(shape)
+
+    return _cmap(state, take)
+
+
+def apply_lane_matrix_ctrl_b(
+    state: CArray, n: int, mt: CArray, ctrl: int
+) -> CArray:
+    """Row-qubit-selected lane-matrix pair on the batched slab (the
+    batched twin of statevector.apply_lane_matrix_ctrl): rows with bit
+    ``ctrl`` = b go through ``mt[…,b]``. ``mt``: (2,128,128) shared or
+    (G,2,128,128) grouped with G | B."""
+    if n < _SLAB_MIN:
+        raise ValueError(f"batched engine needs n ≥ {_SLAB_MIN}, got {n}")
+    if not 0 <= ctrl < n - _LANE_BITS:
+        raise ValueError(f"ctrl must be a row qubit, got {ctrl} (n={n})")
+    b = state.re.shape[0]
+    groups = _coeff_groups(b, mt, 3)
+    mt_re, mt_im = _cast_parts(mt, state.re.dtype)
+    shape = state.re.shape
+    if groups is None:
+        def mm(s, m):
+            return jnp.einsum(
+                "bxcl,xlk->bxck", _row_view(s, b, n, ctrl, None), m
+            )
+    else:
+        def mm(s, m):
+            return jnp.einsum(
+                "gbxcl,gxlk->gbxck", _row_view(s, b, n, ctrl, groups), m
+            )
+
+    rr = mm(state.re, mt_re)
+    if mt_im is None and state.im is None:
+        return CArray(rr.reshape(shape), None)
+    if mt_im is None:
+        return CArray(rr.reshape(shape), mm(state.im, mt_re).reshape(shape))
+    if state.im is None:
+        return CArray(rr.reshape(shape), mm(state.re, mt_im).reshape(shape))
+    return CArray(
+        (rr - mm(state.im, mt_im)).reshape(shape),
+        (mm(state.im, mt_re) + mm(state.re, mt_im)).reshape(shape),
+    )
+
+
+def apply_row_matrix_ctrl_b(
+    state: CArray, n: int, mt: CArray, ctrl: int
+) -> CArray:
+    """Lane-qubit-selected row-matrix pair on the batched slab (the
+    batched twin of statevector.apply_row_matrix_ctrl): lanes with bit
+    ``ctrl`` = b push their rows through ``mt[…,b]``. ``mt``: (2,R,R)
+    shared or (G,2,R,R) grouped with G | B."""
+    if n < _SLAB_MIN:
+        raise ValueError(f"batched engine needs n ≥ {_SLAB_MIN}, got {n}")
+    if not n - _LANE_BITS <= ctrl < n:
+        raise ValueError(f"ctrl must be a lane qubit, got {ctrl} (n={n})")
+    b = state.re.shape[0]
+    groups = _coeff_groups(b, mt, 3)
+    mt_re, mt_im = _cast_parts(mt, state.re.dtype)
+    shape = state.re.shape
+    r = 1 << (n - _LANE_BITS)
+    p = _slab_pos(n, ctrl)
+    h, w = 1 << (_LANE_BITS - p - 1), 1 << p
+    if groups is None:
+        def mm(s, m):
+            return jnp.einsum(
+                "xrs,bshxw->brhxw", m, s.reshape(b, r, h, 2, w)
+            )
+    else:
+        def mm(s, m):
+            return jnp.einsum(
+                "gxrs,gzshxw->gzrhxw",
+                m,
+                s.reshape(groups, b // groups, r, h, 2, w),
+            )
+
+    rr = mm(state.re, mt_re)
+    if mt_im is None and state.im is None:
+        return CArray(rr.reshape(shape), None)
+    if mt_im is None:
+        return CArray(rr.reshape(shape), mm(state.im, mt_re).reshape(shape))
+    if state.im is None:
+        return CArray(rr.reshape(shape), mm(state.re, mt_im).reshape(shape))
+    return CArray(
+        (rr - mm(state.im, mt_im)).reshape(shape),
+        (mm(state.im, mt_re) + mm(state.re, mt_im)).reshape(shape),
+    )
 
 
 def apply_rowpair_b(

@@ -521,6 +521,128 @@ def apply_lane_matrix(state: CArray, mt: CArray) -> CArray:
     return _creshape(_matmul_lane(flat, mt.re, mt.im), shape)
 
 
+def _matmul_row(mt_re, mt_im, state: CArray) -> CArray:
+    """Mt @ s over the row dim with complex parts resolved at trace time
+    (the left-multiply twin of ``_matmul_lane``)."""
+    rr = mt_re @ state.re
+    if mt_im is None and state.im is None:
+        return CArray(rr, None)
+    if mt_im is None:
+        return CArray(rr, mt_re @ state.im)
+    if state.im is None:
+        return CArray(rr, mt_im @ state.re)
+    return CArray(
+        rr - mt_im @ state.im, mt_re @ state.im + mt_im @ state.re
+    )
+
+
+def apply_row_matrix(state: CArray, mt: CArray) -> CArray:
+    """Apply a pre-composed (R,R) operator to ALL row qubits in ONE
+    (R,R)×(R,128) matmul — the row-dim dual of ``apply_lane_matrix`` and
+    the execution primitive of the scan route's row-matrix contraction
+    (ops/fuse.py r17): a layer's row rotations, row-row CNOT chain and
+    row diagonals compose into ``mt`` at trace time, so the whole row
+    region costs one pass. Only emitted at narrow row widths
+    (fuse._ROWMAT_MAX_BITS caps R at one lane register) where the R²
+    FLOPs are MXU change and the composed matrices stay trace-tiny."""
+    n = state.ndim
+    rbits = n - _LANE_BITS
+    if rbits < 1:
+        raise ValueError(f"row matrix needs n > {_LANE_BITS}, got {n}")
+    shape = state.shape
+    mt = _cast_gate(mt, state)
+    flat = _creshape(state, (1 << rbits, _LANES))
+    return _creshape(_matmul_row(mt.re, mt.im, flat), shape)
+
+
+def apply_row_perm(state: CArray, perm) -> CArray:
+    """Apply a static permutation of the row index — a run of row-row
+    CNOTs (the HEA entangler chain) collapsed into ONE gather
+    (ops/fuse.py r17 row-permutation contraction): out[r] = in[perm[r]].
+    ``perm`` is a trace-time integer array (numpy), so the gather indices
+    are constants; works at every row width (no FLOPs, one pass)."""
+    n = state.ndim
+    rbits = n - _LANE_BITS
+    if rbits < 1:
+        raise ValueError(f"row perm needs n > {_LANE_BITS}, got {n}")
+    shape = state.shape
+    idx = jnp.asarray(perm, dtype=jnp.int32)
+    flat = _creshape(state, (1 << rbits, _LANES))
+    out = CArray(
+        flat.re[idx], None if flat.im is None else flat.im[idx]
+    )
+    return _creshape(out, shape)
+
+
+def apply_lane_matrix_ctrl(state: CArray, mt: CArray, ctrl: int) -> CArray:
+    """Apply a ROW-QUBIT-SELECTED pair of lane matrices in one grouped
+    einsum: rows where bit ``ctrl`` = b go through ``mt[b]`` (2,128,128).
+    This is how the fusion pass's cross-boundary lane contraction
+    (ops/fuse.py r17) absorbs the HEA ring's row→lane boundary CNOT into
+    the adjacent lane super-gates: the controlled permutation becomes the
+    branch pair (I, P) and every neighboring pure lane matrix composes
+    into BOTH branches — one dispatch where the r07 program took three
+    (lane · cnot · lane)."""
+    n = state.ndim
+    if not 0 <= ctrl < n - _LANE_BITS:
+        raise ValueError(f"ctrl must be a row qubit, got {ctrl} (n={n})")
+    shape = state.shape
+    mt = _cast_gate(mt, state)
+    view = _creshape(state, _row_split(n, ctrl))  # (a, 2, c, 128)
+
+    def mm(s, m):
+        return jnp.einsum("axcl,xlk->axck", s, m)
+
+    v = view
+    rr = mm(v.re, mt.re)
+    if mt.im is None and v.im is None:
+        out = CArray(rr, None)
+    elif mt.im is None:
+        out = CArray(rr, mm(v.im, mt.re))
+    elif v.im is None:
+        out = CArray(rr, mm(v.re, mt.im))
+    else:
+        out = CArray(
+            rr - mm(v.im, mt.im), mm(v.im, mt.re) + mm(v.re, mt.im)
+        )
+    return _creshape(out, shape)
+
+
+def apply_row_matrix_ctrl(state: CArray, mt: CArray, ctrl: int) -> CArray:
+    """LANE-QUBIT-SELECTED pair of row matrices in one grouped einsum:
+    lanes where bit ``ctrl`` = b push their rows through ``mt[b]``
+    (2,R,R) — the row dual of ``apply_lane_matrix_ctrl``, and how the
+    scan route absorbs the HEA ring's lane→row wrap CNOT into the next
+    layer's row matrix (ops/fuse.py r17 boundary merge)."""
+    n = state.ndim
+    if not n - _LANE_BITS <= ctrl < n:
+        raise ValueError(f"ctrl must be a lane qubit, got {ctrl} (n={n})")
+    rbits = n - _LANE_BITS
+    if rbits < 1:
+        raise ValueError(f"row matrix needs n > {_LANE_BITS}, got {n}")
+    shape = state.shape
+    mt = _cast_gate(mt, state)
+    p = _slab_pos(n, ctrl)
+    view_shape = (1 << rbits, 1 << (_LANE_BITS - p - 1), 2, 1 << p)
+    v = _creshape(state, view_shape)
+
+    def mm(s, m):
+        return jnp.einsum("xrs,shxw->rhxw", m, s)
+
+    rr = mm(v.re, mt.re)
+    if mt.im is None and v.im is None:
+        out = CArray(rr, None)
+    elif mt.im is None:
+        out = CArray(rr, mm(v.im, mt.re))
+    elif v.im is None:
+        out = CArray(rr, mm(v.re, mt.im))
+    else:
+        out = CArray(
+            rr - mm(v.im, mt.im), mm(v.im, mt.re) + mm(v.re, mt.im)
+        )
+    return _creshape(out, shape)
+
+
 def apply_rowpair(state: CArray, gate: CArray, q1: int, q2: int) -> CArray:
     """Apply a merged 4×4 super-gate ``G[o1,o2,i1,i2]`` to two ROW qubits
     q1 < q2 through the slab pair view (a,2,c,2,e,128) in one four-flip

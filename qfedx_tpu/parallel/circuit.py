@@ -16,9 +16,10 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from qfedx_tpu import obs
-from qfedx_tpu.circuits.ansatz import hea_layer_ops
+from qfedx_tpu.circuits.ansatz import hea_layer_ops, hea_scan_ops
 from qfedx_tpu.circuits.encoders import angle_amplitudes
 from qfedx_tpu.ops import fuse
+from qfedx_tpu.ops.cpx import CArray
 from qfedx_tpu.ops.statevector import _LANE_BITS
 from qfedx_tpu.parallel.sharded import (
     ShardCtx,
@@ -111,6 +112,34 @@ def sharded_hea_state(
     n = ctx.n_qubits
     state = sharded_encoded_state(ctx, features, encoding)
     n_layers = params["rx"].shape[0]
+    if not channels and fuse.scan_active(
+        ctx.n_local, n_layers, min_width=_LANE_BITS
+    ):
+        # Scan-over-layers on the sharded state (ops/fuse.py r17): the
+        # layer traces share structure, so ONE scan body applies one
+        # layer through the segment-and-fuse pass below — per-layer
+        # coefficients ride the scan xs, global-qubit ops stay per-gate
+        # barriers INSIDE the body (ppermute collectives scan fine).
+        # Kraus channels disable the scan: a channel is a hard barrier
+        # between layer traces and its PRNG fold-in is layer-indexed.
+        ops = hea_scan_ops(n, params["rx"], params["rz"])
+        xs = tuple(op.coeffs for op in ops if op.coeffs is not None)
+
+        def body(st, sliced):
+            it = iter(sliced)
+            layer = [
+                fuse.Op(
+                    o.kind,
+                    o.qubits,
+                    next(it) if o.coeffs is not None else None,
+                )
+                for o in ops
+            ]
+            return _apply_ops_sharded(ctx, st, layer), None
+
+        state = CArray(state.re, state.imag_or_zeros())
+        state, _ = jax.lax.scan(body, state, xs, length=n_layers)
+        return state
     for layer in range(n_layers):
         # One layer = one IR trace (circuits.ansatz.hea_layer_ops — the
         # exact gate sequence the dense engines run), executed through

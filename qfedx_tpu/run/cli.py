@@ -72,6 +72,13 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--noise-placement", default="readout",
                    choices=["readout", "circuit"],
                    help="analytic readout maps vs sampled Kraus trajectories in-circuit")
+    t.add_argument("--scan-layers", default=None, choices=["on", "off"],
+                   help="scan-over-fused-layers op-count collapse "
+                        "(ops/fuse.py r17): the L structurally-identical "
+                        "fused ansatz layers run as ONE lax.scan super-"
+                        "gate body. Default follows QFEDX_SCAN_LAYERS "
+                        "(on-TPU); the choice is recorded in config.json "
+                        "so `qfedx serve` restores the same route")
     # federated
     t.add_argument("--rounds", type=int, default=30)
     t.add_argument("--local-epochs", type=int, default=5)
@@ -242,6 +249,9 @@ def config_from_args(a: argparse.Namespace) -> ExperimentConfig:
             shots=a.shots,
             noise_placement=a.noise_placement,
             remat=a.remat,
+            scan_layers=(
+                None if a.scan_layers is None else a.scan_layers == "on"
+            ),
         ),
         fed=FedConfig(
             local_epochs=a.local_epochs,
@@ -492,6 +502,9 @@ def run_serve(args) -> dict:
         f"{b} ({v['wall_s']:.2f}s wall, {v['compile_s']:.2f}s compile)"
         for b, v in warm["buckets"].items()
     ))
+    say("[qfedx_tpu] route: " + ", ".join(
+        f"{k}={v}" for k, v in warm["route_resolved"].items()
+    ))
 
     in_f = sys.stdin if args.input == "-" else open(args.input)
     out_f = sys.stdout if args.output == "-" else open(args.output, "w")
@@ -703,6 +716,15 @@ def run_inspect(run_dir) -> dict:
                     for k in ("ops_executed", "gap_p50_us",
                               "device_busy_fraction", "device_busy_s")
                 }
+                # The floor_attribution compact row (obs/profile.py) —
+                # the same shape bench.py prints, so a profiled run dir
+                # answers "did the op-count collapse land here?" from
+                # the read side alone.
+                from qfedx_tpu.obs import profile as obs_profile
+
+                out["floor_attribution"] = obs_profile.floor_attribution(
+                    obj.get("static_state_ops"), obj
+                )
             else:
                 model = (obj.get("model") or {})
                 out["model"] = (
@@ -717,6 +739,8 @@ def run_inspect(run_dir) -> dict:
         f"(best {out['best_accuracy']})")
     if ledger:
         say("[qfedx_tpu] ledger: " + json.dumps(ledger))
+    if "floor_attribution" in out:
+        say("[qfedx_tpu] floor: " + json.dumps(out["floor_attribution"]))
     for problem in invalid[:5]:
         say(f"[qfedx_tpu] invalid metrics record: {problem}")
     for name in bad_artifacts:

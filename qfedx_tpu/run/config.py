@@ -70,6 +70,11 @@ class ModelConfig:
     # Checkpoint each ansatz layer during autodiff (dense VQC): residual
     # memory per sample drops from O(gates)·2^n to O(layers)·2^n.
     remat: bool = False
+    # Scan-over-fused-layers (ops/fuse.py r17): None follows the
+    # QFEDX_SCAN_LAYERS pin (default: backend — on-TPU); True/False pin
+    # the route for THIS experiment and travel with config.json, so a
+    # `qfedx serve` restore reproduces the training-time route.
+    scan_layers: bool | None = None
 
 
 @dataclass(frozen=True)
@@ -156,9 +161,52 @@ def experiment_config_from_dict(d: dict) -> ExperimentConfig:
     )
 
 
+# [baseline, last_written]: the pre-override value of QFEDX_SCAN_LAYERS
+# plus the value our last explicit override wrote (empty = never
+# overridden; baseline None = "was unset"). A later build with
+# scan_layers=None must get the OPERATOR's pin state back, not a
+# previous experiment's explicit choice — and if the env changed hands
+# between builds (a bench _with_env lever, an operator export), that
+# newer value IS the operator's state: restoring the stale baseline
+# over it would silently re-route the next trace. So a restore only
+# fires while the env still holds our own write, and an external
+# change re-baselines the next override.
+_SCAN_ENV_SAVED: list = []
+
+
 def build_model(cfg: ExperimentConfig, num_classes: int):
     """ModelConfig → Model (with noise bundle when any noise is on)."""
+    import os
+
     m = cfg.model
+    if m.scan_layers is not None:
+        # Routing pins are read at TRACE time, so the config's explicit
+        # choice must land in the environment before the first trace of
+        # this model — build_model is the one seam every entry point
+        # (train, sweep, serve restore) funnels through. Like every
+        # trace-time pin (statevector._gate_form's warning), the pin
+        # state at FIRST TRACE wins: build and trace one experiment's
+        # model before building the next (train/sweep/serve all do).
+        cur = os.environ.get("QFEDX_SCAN_LAYERS")
+        if not _SCAN_ENV_SAVED or cur != _SCAN_ENV_SAVED[1]:
+            # First override, or the pin changed hands since our last
+            # write: the current value is the new restore baseline.
+            _SCAN_ENV_SAVED[:] = [cur, None]
+        val = "1" if m.scan_layers else "0"
+        os.environ["QFEDX_SCAN_LAYERS"] = val
+        _SCAN_ENV_SAVED[1] = val
+    elif _SCAN_ENV_SAVED:
+        # scan_layers=None follows the pin: restore what the operator
+        # had before an earlier build's explicit override — unless the
+        # env moved on since that write, in which case the newer state
+        # wins and the stale baseline is dropped.
+        saved, written = _SCAN_ENV_SAVED
+        _SCAN_ENV_SAVED.clear()
+        if os.environ.get("QFEDX_SCAN_LAYERS") == written:
+            if saved is None:
+                os.environ.pop("QFEDX_SCAN_LAYERS", None)
+            else:
+                os.environ["QFEDX_SCAN_LAYERS"] = saved
     if m.model == "cnn":
         from qfedx_tpu.models.cnn import make_tiny_cnn
         from qfedx_tpu.data.datasets import SPECS

@@ -244,6 +244,7 @@ def _config_from_cell(cell: dict, seed: int) -> ExperimentConfig:
             readout_flip=cell.get("readout_flip", 0.0),
             shots=cell.get("shots"),
             noise_placement=cell.get("noise_placement", "readout"),
+            scan_layers=cell.get("scan_layers"),
         ),
         fed=FedConfig(
             local_epochs=cell.get("local_epochs", 1),

@@ -46,7 +46,7 @@ from typing import Any
 import numpy as np
 
 from qfedx_tpu import obs
-from qfedx_tpu.serve.forward import persistent_forward
+from qfedx_tpu.serve.forward import _ROUTING_PINS, persistent_forward
 from qfedx_tpu.utils import faults, pins
 from qfedx_tpu.utils.retry import retry_with_deadline
 
@@ -202,7 +202,29 @@ class ServeEngine:
             }
         self._warm = True
         obs.counter("serve.warmup_buckets", len(per_bucket))
-        return {"buckets": per_bucket, "num_classes": int(out.shape[-1])}
+        # The engine-routing pins (serve/forward.py) of the programs
+        # just compiled: ``route`` is the raw env snapshot (exact repro
+        # of this process's routing key), ``route_resolved`` the
+        # backend-defaulted answers — on a default deploy every raw pin
+        # is "" and only the resolved values say whether the bucket
+        # floor is the r17 scanned or the per-layer program.
+        from qfedx_tpu.ops import fuse
+        from qfedx_tpu.ops.cpx import state_dtype
+
+        return {
+            "buckets": per_bucket,
+            "num_classes": int(out.shape[-1]),
+            "route": {p: os.environ.get(p, "") for p in _ROUTING_PINS},
+            "route_resolved": {
+                "dtype": np.dtype(state_dtype()).name,
+                "fuse": fuse.fuse_enabled(),
+                # Conjoined with fuse: the scan route is built ON the
+                # fused forms and can never engage without them. Width/
+                # depth gates (fuse.scan_active) live below the engine —
+                # models are opaque callables here.
+                "scan_layers": fuse.scan_enabled() and fuse.fuse_enabled(),
+            },
+        }
 
     # -- inference -----------------------------------------------------------
 

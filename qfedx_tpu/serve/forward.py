@@ -21,8 +21,9 @@ wrapper for ``fwd``, keyed on:
   global WeakKeyDictionary cannot work here: its values would hold the
   key alive through ``jax.jit``'s own reference and nothing would ever
   evict);
-- the engine-routing pins (QFEDX_DTYPE / QFEDX_FUSE / QFEDX_BATCHED /
-  QFEDX_GATE_FORM / QFEDX_SLAB_LANES / QFEDX_FOLD_CLIENTS), resolved
+- the engine-routing pins (QFEDX_DTYPE / QFEDX_FUSE / QFEDX_SCAN_LAYERS
+  / QFEDX_BATCHED / QFEDX_GATE_FORM / QFEDX_SLAB_LANES /
+  QFEDX_FOLD_CLIENTS), resolved
   PER CALL: the pins are read at trace time, so one jit wrapper used
   across a pin flip would cache the flipped route's executable under
   the old identity (the bench's with_env A/B levers flip pins around
@@ -51,6 +52,7 @@ import jax
 _ROUTING_PINS = (
     "QFEDX_DTYPE",
     "QFEDX_FUSE",
+    "QFEDX_SCAN_LAYERS",
     "QFEDX_BATCHED",
     "QFEDX_GATE_FORM",
     "QFEDX_SLAB_LANES",

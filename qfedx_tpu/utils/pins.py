@@ -48,6 +48,21 @@ def bool_pin(name: str, default: bool | Callable[[], bool]) -> bool:
     return val
 
 
+def tpu_backend_default() -> bool:
+    """The shared lazy default of the engine-routing pins whose route
+    "follows the backend" (QFEDX_FUSE, QFEDX_SCAN_LAYERS): True exactly
+    when the default JAX backend is TPU. Lazy on purpose — pass it as
+    ``bool_pin``'s default so the backend is only initialized when the
+    pin does not decide (probing it eagerly would pin the platform
+    before callers could select one; see models/vqc's routing note)."""
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001 — no backend yet: conservative
+        return False
+
+
 def float_pin(name: str, default: float) -> float:
     """Resolve a float-valued pin (QFEDX_SERVE_DEADLINE_MS /
     QFEDX_SERVE_SLO_MS) with the family's loud grammar: unset → default,

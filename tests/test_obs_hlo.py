@@ -28,12 +28,14 @@ _TPU_ROUTING = {
 }
 
 
-def _state_ops(monkeypatch, fuse_pin: str, n=12, layers=2, batch=4) -> dict:
+def _state_ops(monkeypatch, fuse_pin: str, n=12, layers=2, batch=4,
+               scan_pin: str = "off") -> dict:
     from benchmarks._util import build_step
 
     for k, v in _TPU_ROUTING.items():
         monkeypatch.setenv(k, v)
     monkeypatch.setenv("QFEDX_FUSE", fuse_pin)
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", scan_pin)
     fn, params, _ = build_step(n, layers, batch, steps=1)
     return module_counts(fn, params, n, compiled=False)
 
@@ -49,6 +51,49 @@ def test_fused_fewer_state_ops_than_unfused(monkeypatch):
     # Raw totals are NOT the metric (fusion adds tiny composition ops);
     # the census must keep reporting both so nobody regresses to totals.
     assert fused["lowered_ops"] > fused["lowered_state_ops"]
+
+
+# The scanned step's census budget at (n=12, L=2, B=4): measured 336 on
+# this container (r17) vs 1939 r07-fused — the budget leaves slack for
+# lowering drift but fails LONG before anything re-unrolls the layers
+# (one extra per-layer copy of the body would blow past it).
+_SCANNED_BUDGET = 600
+
+
+def test_scanned_census_below_fused_and_budget(monkeypatch):
+    """The r17 op-count collapse can't silently regress: the scanned
+    step lowers STRICTLY below the r07-fused census and under an
+    absolute budget (ISSUE r17 satellite)."""
+    fused = _state_ops(monkeypatch, "1")
+    scanned = _state_ops(monkeypatch, "1", scan_pin="1")
+    assert (
+        0
+        < scanned["lowered_state_ops"]
+        < fused["lowered_state_ops"]
+    ), (
+        f"scan no longer reduces state-sized ops: "
+        f"scanned={scanned['lowered_state_ops']} "
+        f"fused={fused['lowered_state_ops']}"
+    )
+    assert scanned["lowered_state_ops"] < _SCANNED_BUDGET, (
+        f"scanned census {scanned['lowered_state_ops']} exceeds the "
+        f"absolute budget {_SCANNED_BUDGET} — did the body grow or the "
+        "layer stack partially unroll?"
+    )
+
+
+def test_scanned_census_depth_invariant(monkeypatch):
+    """THE signature of scan-over-fused-layers: the lowered program
+    contains the super-gate body ONCE, so the static census does not
+    grow with layer count (the r07-fused census grows linearly). jax
+    lowers the backward scan slightly differently for length ≤ 3, so
+    the exact-equality pin sits in the asymptotic regime and shallow
+    stacks are only required not to exceed it."""
+    two = _state_ops(monkeypatch, "1", layers=2, scan_pin="1")
+    four = _state_ops(monkeypatch, "1", layers=4, scan_pin="1")
+    six = _state_ops(monkeypatch, "1", layers=6, scan_pin="1")
+    assert four["lowered_state_ops"] == six["lowered_state_ops"]
+    assert two["lowered_state_ops"] <= four["lowered_state_ops"]
 
 
 def test_count_state_ops_scans_operands_and_results():

@@ -1,0 +1,749 @@
+"""Scan-over-fused-layers (ops/fuse.py r17) ≡ fused ≡ per-gate execution.
+
+Parity is pinned at the same four altitudes as tests/test_fuse.py:
+
+- pass: ``fuse_ops_stacked`` collapses a layer-stacked HEA trace into
+  the expected super-gate body (row matrix + ctrl'd lane matrix + wrap
+  CNOT at narrow rows; row pairs + a row permutation past the row-matrix
+  cap) and the cross-layer boundary merge hoists layer 0's head;
+- primitives: the r17 engine ops (row matrix, row permutation, row-/
+  lane-controlled matrix pairs) ≡ their gate-sequence definitions on
+  dense and batched states, shared and grouped;
+- ops: one ``apply_scan`` over the stacked program ≡ the gate-by-gate
+  reference layer by layer — dense, batched with per-client (G,…) and
+  per-sample (B,…) coefficient stacks;
+- model: QFEDX_SCAN_LAYERS=1 ≡ =0 logits AND gradients for HEA and
+  reupload on the batched engine and the client-folded path, f32
+  (≤ 2e-5) and bf16 (rounding-bounded), with circuit-level Kraus noise
+  interleaved (channels are scan barriers: the per-layer loop is kept
+  and trajectories coincide sample-for-sample), and on the sharded
+  engine's local runs.
+
+All tests pin the TPU production formulation (flip gate form + matmul
+lanes) so the scanned slab programs are covered on the CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qfedx_tpu.circuits import ansatz
+from qfedx_tpu.ops import batched as bt
+from qfedx_tpu.ops import fuse, gates
+from qfedx_tpu.ops import statevector as sv
+from qfedx_tpu.ops.cpx import CArray, from_complex, to_complex
+
+N = 10  # smallest slab width
+
+
+@pytest.fixture
+def tpu_form(monkeypatch):
+    monkeypatch.setenv("QFEDX_GATE_FORM", "flip")
+    monkeypatch.setenv("QFEDX_SLAB_LANES", "matmul")
+    monkeypatch.setenv("QFEDX_FUSE", "1")
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "1")
+
+
+def _rand_state(n: int, seed: int = 0) -> CArray:
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(2,) * n) + 1j * rng.normal(size=(2,) * n)
+    return from_complex(x / np.linalg.norm(x))
+
+
+def _stacks(n, n_layers, seed=0):
+    rng = np.random.default_rng(seed)
+    rx = jnp.asarray(rng.uniform(-2, 2, (n_layers, n)), dtype=jnp.float32)
+    rz = jnp.asarray(rng.uniform(-2, 2, (n_layers, n)), dtype=jnp.float32)
+    return rx, rz
+
+
+def _ref_layers(state, n, rx, rz):
+    for l in range(rx.shape[0]):
+        state = fuse.apply_ops_unfused(
+            state, ansatz.hea_layer_ops(n, rx[l], rz[l])
+        )
+    return state
+
+
+# --- the pin and the gates ---------------------------------------------------
+
+
+def test_scan_pin_rejects_invalid(monkeypatch):
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "banana")
+    with pytest.raises(ValueError, match="QFEDX_SCAN_LAYERS"):
+        fuse.scan_enabled()
+
+
+@pytest.mark.parametrize(
+    "pin,expect", [("1", True), ("on", True), ("0", False), ("off", False)]
+)
+def test_scan_pin_values(monkeypatch, pin, expect):
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", pin)
+    assert fuse.scan_enabled() is expect
+
+
+def test_scan_gates_on_fuse_width_and_depth(monkeypatch):
+    """The scan route needs an active fusion route AND ≥ 2 layers —
+    QFEDX_SCAN_LAYERS=1 alone must not engage below the slab or with
+    fusion pinned off (scan is built ON the fused forms)."""
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "1")
+    monkeypatch.setenv("QFEDX_FUSE", "1")
+    assert fuse.scan_active(N, 2) is True
+    assert fuse.scan_active(N, 1) is False
+    assert fuse.scan_active(8, 2) is False
+    monkeypatch.setenv("QFEDX_FUSE", "0")
+    assert fuse.scan_active(N, 2) is False
+
+
+def test_scan_off_never_builds_stacked_program(monkeypatch, tpu_form):
+    """QFEDX_SCAN_LAYERS=0 reproduces the r07 route bit-for-bit: the
+    stacked pass is never entered (the r07 code path is untouched)."""
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "0")
+
+    def boom(*a, **k):  # pragma: no cover - failure mode
+        raise AssertionError("fuse_ops_stacked called with scan off")
+
+    monkeypatch.setattr(fuse, "fuse_ops_stacked", boom)
+    rx, rz = _stacks(N, 3)
+    state = _rand_state(N)
+    ansatz.hardware_efficient(state, {"rx": rx, "rz": rz})
+
+
+def test_build_model_scan_env_seam(monkeypatch):
+    """build_model's explicit scan_layers override is undone by a later
+    scan_layers=None build (the operator's pre-override pin comes back),
+    but an env change BETWEEN builds — a bench _with_env lever, an
+    operator export — wins over the stale baseline: restoring over it
+    would silently re-route the next trace."""
+    import os
+
+    from qfedx_tpu.run import config as rc
+
+    def cfg(scan):
+        return rc.ExperimentConfig(
+            model=rc.ModelConfig(scan_layers=scan)
+        )
+
+    monkeypatch.setattr(rc, "_SCAN_ENV_SAVED", [])
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "on")
+    rc.build_model(cfg(True), 2)
+    assert os.environ["QFEDX_SCAN_LAYERS"] == "1"
+    rc.build_model(cfg(None), 2)  # follows the pin: operator state back
+    assert os.environ["QFEDX_SCAN_LAYERS"] == "on"
+
+    rc.build_model(cfg(True), 2)
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "off")  # external change
+    rc.build_model(cfg(None), 2)
+    assert os.environ["QFEDX_SCAN_LAYERS"] == "off", (
+        "a pin set after the override must not be clobbered by the "
+        "stale pre-override baseline"
+    )
+
+
+# --- pass-level structure ----------------------------------------------------
+
+
+def test_hea_stacked_structure_narrow_rows(tpu_form):
+    """At row widths within the row-matrix cap the whole L-layer HEA
+    collapses to a 3-op body: row matrix, row-controlled lane-matrix
+    pair (the boundary CNOT absorbed), wrap CNOT."""
+    rx, rz = _stacks(N, 3)
+    prog = fuse.fuse_ops_stacked(ansatz.hea_scan_ops(N, rx, rz), N, 3)
+    kinds = [o.kind for o in prog.body]
+    assert kinds == ["rowmat", "glane", "cnot"]
+    assert prog.body[0].stacked and prog.body[1].stacked
+    assert not prog.body[2].stacked
+    assert prog.body[1].qubits[0] == 2  # ctrl row qubit of CNOT(2,3)
+    assert prog.length == 3
+
+
+def test_hea_stacked_structure_growmat(monkeypatch, tpu_form):
+    """On the dispatch-bound backend the wrap CNOT merges into the next
+    layer's row matrix: body [glane, growmat], layer-0 rowmat hoisted."""
+    monkeypatch.setattr(fuse, "_growmat_merge_ok", lambda: True)
+    rx, rz = _stacks(N, 3)
+    prog = fuse.fuse_ops_stacked(ansatz.hea_scan_ops(N, rx, rz), N, 3)
+    assert [o.kind for o in prog.pre] == ["rowmat"]
+    assert [o.kind for o in prog.body] == ["glane", "growmat"]
+    assert prog.body[1].qubits[0] == N - 1  # ctrl lane qubit
+
+
+def test_hea_stacked_structure_wide_rows(monkeypatch, tpu_form):
+    """Past the row-matrix cap rows fall back to pairs; the CNOT chain
+    becomes ONE gather-applied row permutation on backends whose
+    gather/scatter are single kernels, and stays per-gate elsewhere."""
+    monkeypatch.setattr(fuse, "_ROWMAT_MAX_BITS", 1)
+    monkeypatch.setattr(fuse, "_gather_ok", lambda: True)
+    rx, rz = _stacks(N, 2)
+    prog = fuse.fuse_ops_stacked(ansatz.hea_scan_ops(N, rx, rz), N, 2)
+    kinds = [o.kind for o in prog.body]
+    assert kinds.count("rowpair") == 1  # qubits (0,1)
+    assert kinds.count("g1") == 1  # unpaired row qubit 2
+    assert kinds.count("rowperm") == 1  # the row CNOT chain
+    assert kinds.count("glane") == 1
+    monkeypatch.setattr(fuse, "_gather_ok", lambda: False)
+    prog2 = fuse.fuse_ops_stacked(ansatz.hea_scan_ops(N, rx, rz), N, 2)
+    kinds2 = [o.kind for o in prog2.body]
+    assert kinds2.count("rowperm") == 0
+    assert kinds2.count("cnot") > kinds.count("cnot")
+
+
+def test_stacked_trace_rejects_wrong_layer_axis(tpu_form):
+    rx, rz = _stacks(N, 3)
+    ops = ansatz.hea_scan_ops(N, rx, rz)
+    with pytest.raises(ValueError, match="layer count"):
+        fuse.fuse_ops_stacked(ops, N, 4)
+
+
+# --- primitive parity --------------------------------------------------------
+
+
+def test_row_matrix_primitive(tpu_form):
+    """apply_row_matrix(M_B@M_A) ≡ the two row gates in sequence."""
+    state = _rand_state(N, 1)
+    rbits = N - 7
+    A, B_ = gates.rot_zx(0.3, -0.9), gates.ry(1.2)
+    ma = fuse._kron_matrix({fuse._row_pos(rbits, 0): A}, rbits)
+    mb = fuse._kron_matrix({fuse._row_pos(rbits, 2): B_}, rbits)
+    out = sv.apply_row_matrix(state, fuse._cmatmul(mb, ma))
+    ref = sv.apply_gate(sv.apply_gate(state, A, 0), B_, 2)
+    np.testing.assert_allclose(to_complex(out), to_complex(ref), atol=1e-6)
+
+
+def test_row_perm_primitive(tpu_form):
+    """apply_row_perm(σ-chain) ≡ the row-row CNOTs in sequence."""
+    state = _rand_state(N, 2)
+    rbits = N - 7
+    chain = [(0, 1), (1, 2)]
+    sigma = None
+    for c, t in chain:
+        s = fuse._row_cnot_sigma(
+            fuse._row_pos(rbits, c), fuse._row_pos(rbits, t), rbits
+        )
+        sigma = s if sigma is None else sigma[s]
+    out = sv.apply_row_perm(state, sigma)
+    ref = state
+    for c, t in chain:
+        ref = sv.apply_cnot(ref, c, t)
+    np.testing.assert_allclose(to_complex(out), to_complex(ref), atol=1e-6)
+    # batched twin
+    b = CArray(
+        jnp.stack([state.re.reshape(-1)] * 2),
+        jnp.stack([state.im.reshape(-1)] * 2),
+    )
+    outb = bt.apply_row_perm_b(b, N, sigma)
+    np.testing.assert_allclose(
+        np.asarray(outb.re[0]), np.asarray(out.re).reshape(-1), atol=1e-6
+    )
+
+
+def test_lane_matrix_ctrl_primitive(tpu_form):
+    """apply_lane_matrix_ctrl ≡ (boundary CNOT then lane gate): branch 0
+    = plain matrix, branch 1 = perm-then-matrix."""
+    state = _rand_state(N, 3)
+    ctrl, tgt = 2, N - 1  # CNOT(2,9): row control, lane target
+    g = gates.rot_zx(0.8, 0.4)
+    mt_g = fuse._lane_g1(g, sv._slab_pos(N, N - 2))
+    perm = CArray(jnp.asarray(fuse._np_lane_flip(sv._slab_pos(N, tgt))), None)
+    pair = CArray(
+        jnp.stack([mt_g.re, fuse._cmatmul(perm, mt_g).re]),
+        jnp.stack([mt_g.im, fuse._cmatmul(perm, mt_g).im]),
+    )
+    out = sv.apply_lane_matrix_ctrl(state, pair, ctrl)
+    ref = sv.apply_cnot(state, ctrl, tgt)
+    ref = sv.apply_gate(ref, g, N - 2)
+    np.testing.assert_allclose(to_complex(out), to_complex(ref), atol=1e-6)
+
+
+def test_row_matrix_ctrl_primitive(tpu_form):
+    """apply_row_matrix_ctrl ≡ (wrap CNOT then row gate): lanes with the
+    control bit set take the flipped-then-rotated branch."""
+    state = _rand_state(N, 4)
+    rbits = N - 7
+    ctrl, tgt = N - 1, 0  # CNOT(9, 0): lane control, row target
+    g = gates.rot_zx(-0.6, 1.1)
+    mr = fuse._kron_matrix({fuse._row_pos(rbits, 1): g}, rbits)
+    flip = fuse._sigma_matrix(
+        np.arange(1 << rbits) ^ (1 << fuse._row_pos(rbits, tgt))
+    )
+    m_flip = fuse._cmatmul(mr, flip)
+    pair = CArray(
+        jnp.stack([mr.re, m_flip.re]), jnp.stack([mr.im, m_flip.im])
+    )
+    out = sv.apply_row_matrix_ctrl(state, pair, ctrl)
+    ref = sv.apply_gate(sv.apply_cnot(state, ctrl, tgt), g, 1)
+    np.testing.assert_allclose(to_complex(out), to_complex(ref), atol=1e-6)
+
+
+def test_batched_primitives_grouped(tpu_form):
+    """Grouped (G,…) stacks through the batched r17 primitives ≡ the
+    per-row dense primitives."""
+    G, S = 2, 2
+    B = G * S
+    rng = np.random.default_rng(5)
+    re = jnp.asarray(rng.standard_normal((B, 1 << N)), dtype=jnp.float32)
+    im = jnp.asarray(rng.standard_normal((B, 1 << N)), dtype=jnp.float32)
+    state = CArray(re, im)
+    rbits = N - 7
+    th = jnp.asarray(rng.uniform(-2, 2, (G,)), dtype=jnp.float32)
+    g = gates.rot_zx_batched(th, -th)  # (G,2,2)
+    mr = fuse._kron_matrix({fuse._row_pos(rbits, 1): g}, rbits)  # (G,R,R)
+    out = bt.apply_row_matrix_b(state, N, mr)
+    for r in range(B):
+        one = CArray(re[r].reshape((2,) * N), im[r].reshape((2,) * N))
+        gi = r // S
+        ref = sv.apply_gate(
+            one, CArray(g.re[gi], g.im[gi]), 1
+        )
+        np.testing.assert_allclose(
+            np.asarray(out.re[r]),
+            np.asarray(ref.re).reshape(-1),
+            atol=1e-5,
+        )
+    bad = CArray(jnp.zeros((3, 1 << rbits, 1 << rbits)), None)  # 3 ∤ 4
+    with pytest.raises(ValueError, match="G must divide B"):
+        bt.apply_row_matrix_b(state, N, bad)
+
+
+def test_ctrl_primitives_validate_region(tpu_form):
+    state = _rand_state(N, 6)
+    pair = CArray(jnp.zeros((2, 128, 128)), None)
+    with pytest.raises(ValueError, match="row qubit"):
+        sv.apply_lane_matrix_ctrl(state, pair, N - 1)
+    rpair = CArray(jnp.zeros((2, 8, 8)), None)
+    with pytest.raises(ValueError, match="lane qubit"):
+        sv.apply_row_matrix_ctrl(state, rpair, 0)
+
+
+# --- ops-level parity --------------------------------------------------------
+
+
+def test_scanned_hea_dense_parity(tpu_form):
+    rx, rz = _stacks(N, 3, seed=1)
+    state = _rand_state(N, 7)
+    prog = fuse.fuse_ops_stacked(ansatz.hea_scan_ops(N, rx, rz), N, 3)
+    out = fuse.apply_scan(state, N, prog)
+    ref = _ref_layers(state, N, rx, rz)
+    np.testing.assert_allclose(to_complex(out), to_complex(ref), atol=2e-5)
+
+
+def test_scanned_growmat_dense_parity(monkeypatch, tpu_form):
+    monkeypatch.setattr(fuse, "_growmat_merge_ok", lambda: True)
+    rx, rz = _stacks(N, 3, seed=2)
+    state = _rand_state(N, 8)
+    prog = fuse.fuse_ops_stacked(ansatz.hea_scan_ops(N, rx, rz), N, 3)
+    assert any(o.kind == "growmat" for o in prog.body)
+    out = fuse.apply_scan(state, N, prog)
+    ref = _ref_layers(state, N, rx, rz)
+    np.testing.assert_allclose(to_complex(out), to_complex(ref), atol=2e-5)
+
+
+def test_scanned_wide_row_dense_parity(monkeypatch, tpu_form):
+    """The past-the-cap mechanisms (row pairs + rowperm gather) execute
+    correctly — the cap is lowered so the wide path runs at a cheap
+    width instead of a pathological-compile n ≥ 15 CPU program."""
+    monkeypatch.setattr(fuse, "_ROWMAT_MAX_BITS", 1)
+    monkeypatch.setattr(fuse, "_gather_ok", lambda: True)
+    rx, rz = _stacks(N, 2, seed=3)
+    state = _rand_state(N, 9)
+    prog = fuse.fuse_ops_stacked(ansatz.hea_scan_ops(N, rx, rz), N, 2)
+    assert any(o.kind == "rowperm" for o in prog.body)
+    out = fuse.apply_scan(state, N, prog)
+    ref = _ref_layers(state, N, rx, rz)
+    np.testing.assert_allclose(to_complex(out), to_complex(ref), atol=2e-5)
+
+
+def test_scanned_batched_grouped_parity(tpu_form):
+    """Per-client (G,…) + per-sample (B,…) stacks ride the scan with the
+    r06/r07 grouping contract intact."""
+    G, S = 2, 3
+    B = G * S
+    L = 3
+    rng = np.random.default_rng(6)
+    re = jnp.asarray(rng.standard_normal((B, 1 << N)), dtype=jnp.float32)
+    im = jnp.asarray(rng.standard_normal((B, 1 << N)), dtype=jnp.float32)
+    state = CArray(re, im)
+    rxc = jnp.asarray(rng.uniform(-2, 2, (L, G, N)), dtype=jnp.float32)
+    rzc = jnp.asarray(rng.uniform(-2, 2, (L, G, N)), dtype=jnp.float32)
+    enc = jnp.asarray(rng.uniform(-2, 2, (L, B, N)), dtype=jnp.float32)
+    ops = [
+        fuse.Op("g1", (q,), gates.ry_batched(enc[:, :, q])) for q in range(N)
+    ] + ansatz.hea_scan_ops(N, rxc, rzc)
+    out = fuse.apply_scan(
+        state, N, fuse.fuse_ops_stacked(ops, N, L), batched=True
+    )
+
+    def one_row(r):
+        st = CArray(re[r].reshape((2,) * N), im[r].reshape((2,) * N))
+        g = r // S
+        for l in range(L):
+            for q in range(N):
+                st = sv.apply_gate(
+                    st, CArray(gates.ry_batched(enc[l, :, q]).re[r], None), q
+                )
+            for q in range(N):
+                c = gates.rot_zx_batched(rxc[l, :, q], rzc[l, :, q])
+                st = sv.apply_gate(st, CArray(c.re[g], c.im[g]), q)
+            for q in range(N - 1):
+                st = sv.apply_cnot(st, q, q + 1)
+            st = sv.apply_cnot(st, N - 1, 0)
+        return st
+
+    for r in range(B):
+        ref = one_row(r)
+        np.testing.assert_allclose(
+            np.asarray(out.re[r]), np.asarray(ref.re).reshape(-1), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(out.im[r]), np.asarray(ref.im).reshape(-1), atol=1e-5
+        )
+
+
+def test_boundary_merge_masks(tpu_form):
+    """Cross-layer diagonal chaining: a body bounded by masks hoists the
+    layer-0 head and folds tail[l]·head[l+1] — one boundary op per
+    layer — with exact parity."""
+    L = 3
+    rng = np.random.default_rng(7)
+    th = jnp.asarray(rng.uniform(-2, 2, (L,)), dtype=jnp.float32)
+    # diag(0) | g1(0) | diag(0): the row single flushes the head chain,
+    # the tail diag starts a fresh one -> [mask, g1, mask] body.
+    ops = [
+        fuse.Op("diag1", (0,), gates.rz_diag(th)),
+        fuse.Op("g1", (0,), gates.ry_batched(th)),
+        fuse.Op("diag1", (0,), gates.rz_diag(2 * th)),
+    ]
+    # Disable the row-matrix fold so the structure is mask/g1/mask.
+    import unittest.mock as mock
+
+    with mock.patch.object(fuse, "_ROWMAT_MAX_BITS", 0):
+        prog = fuse.fuse_ops_stacked(ops, N, L)
+    assert [o.kind for o in prog.pre] == ["mask"]
+    assert [o.kind for o in prog.body] == ["g1", "mask"]
+    state = _rand_state(N, 10)
+    out = fuse.apply_scan(state, N, prog)
+    ref = state
+    for l in range(L):
+        ref = fuse.apply_ops_unfused(
+            ref,
+            [
+                fuse.Op("diag1", (0,), gates.rz_diag(th[l])),
+                fuse.Op("g1", (0,), gates.ry(th[l])),
+                fuse.Op("diag1", (0,), gates.rz_diag(2 * th[l])),
+            ],
+        )
+    np.testing.assert_allclose(to_complex(out), to_complex(ref), atol=2e-5)
+
+
+def test_stacked_g2_requires_layer_axis(tpu_form):
+    """A g2 rides the scan xs untouched, so a layer-constant (2,2,2,2)
+    coefficient must be rejected loudly — at L=2 its first GATE axis
+    equals the layer count and the scan would silently slice it."""
+    rng = np.random.default_rng(21)
+    flat = jnp.asarray(
+        rng.normal(size=(2, 2, 2, 2)), dtype=jnp.float32
+    )
+    with pytest.raises(ValueError, match="leading"):
+        fuse.fuse_ops_stacked(
+            [fuse.Op("g2", (0, 1), CArray(flat, None))], N, 2
+        )
+
+
+def test_grouped_diag_row_fold_capped(tpu_form):
+    """A per-sample diagonal stack past _ROWMAT_GROUP_MAX must not fold
+    into the row matrix (more (L,B,R,R) matrix than state) — it chains
+    on the mask path instead."""
+    import unittest.mock as mock
+
+    L, B = 2, 4
+    rng = np.random.default_rng(22)
+    th = jnp.asarray(rng.uniform(-2, 2, (L, B)), dtype=jnp.float32)
+    th1 = jnp.asarray(rng.uniform(-2, 2, (L,)), dtype=jnp.float32)
+    ops = [
+        fuse.Op("g1", (0,), gates.ry_batched(th1)),  # opens a rowmat
+        fuse.Op("diag1", (1,), gates.rz_diag(th)),  # grouped (L,B,2)
+    ]
+    with mock.patch.object(fuse, "_ROWMAT_GROUP_MAX", 1):
+        prog = fuse.fuse_ops_stacked(ops, N, L)
+    kinds = [o.kind for o in prog.pre + prog.body]
+    assert "mask" in kinds, kinds
+    assert all(o.kind != "rowmat" or o.coeffs.re.ndim <= 3
+               for o in prog.pre + prog.body if o.coeffs is not None)
+
+
+def test_ctrl_cnot_after_collapse(tpu_form):
+    """A second same-control boundary CNOT arriving after a lane gate
+    collapsed the first pair into the matrix form must restart the
+    static pair, not crash (general-IR path; HEA never orders ops this
+    way). Parity vs the per-gate reference pins the composition."""
+    L = 2
+    rng = np.random.default_rng(13)
+    th = jnp.asarray(rng.uniform(-2, 2, (L,)), dtype=jnp.float32)
+    ops = [
+        fuse.Op("cnot", (2, N - 1)),  # row ctrl → lane target
+        fuse.Op("g1", (N - 2,), gates.ry_batched(th)),  # collapses pair
+        fuse.Op("cnot", (2, N - 3)),  # same ctrl, new lane target
+    ]
+    prog = fuse.fuse_ops_stacked(ops, N, L)
+    assert [o.kind for o in prog.body] == ["glane"]
+    state = _rand_state(N, 14)
+    out = fuse.apply_scan(state, N, prog)
+    ref = state
+    for l in range(L):
+        ref = fuse.apply_ops_unfused(
+            ref,
+            [
+                fuse.Op("cnot", (2, N - 1)),
+                fuse.Op("g1", (N - 2,), gates.ry(th[l])),
+                fuse.Op("cnot", (2, N - 3)),
+            ],
+        )
+    np.testing.assert_allclose(to_complex(out), to_complex(ref), atol=2e-5)
+
+
+def test_boundary_merge_mixed_groups(tpu_form):
+    """Boundary merge with a grouped head and an ungrouped tail of the
+    same kind: the final tail layer broadcasts across the groups
+    instead of a rank-mismatched concat (general-IR path)."""
+    L, G, S = 2, 2, 2
+    B = G * S
+    rng = np.random.default_rng(15)
+    thg = jnp.asarray(rng.uniform(-2, 2, (L, G)), dtype=jnp.float32)
+    th = jnp.asarray(rng.uniform(-2, 2, (L,)), dtype=jnp.float32)
+    # grouped row rot | lane-ctrl-row cnot (flushes the rowmat) |
+    # shared row rot — head rowmat grouped, tail rowmat ungrouped.
+    ops = [
+        fuse.Op("g1", (0,), gates.ry_batched(thg)),
+        fuse.Op("cnot", (N - 1, 1)),
+        fuse.Op("g1", (0,), gates.ry_batched(th)),
+    ]
+    prog = fuse.fuse_ops_stacked(ops, N, L)
+    kinds = [o.kind for o in prog.pre + prog.body]
+    assert kinds.count("rowmat") >= 1
+    re = jnp.asarray(rng.standard_normal((B, 1 << N)), dtype=jnp.float32)
+    im = jnp.asarray(rng.standard_normal((B, 1 << N)), dtype=jnp.float32)
+    out = fuse.apply_scan(CArray(re, im), N, prog, batched=True)
+
+    def one_row(r):
+        st = CArray(re[r].reshape((2,) * N), im[r].reshape((2,) * N))
+        g = r // S
+        for l in range(L):
+            st = sv.apply_gate(st, gates.ry(thg[l, g]), 0)
+            st = sv.apply_cnot(st, N - 1, 1)
+            st = sv.apply_gate(st, gates.ry(th[l]), 0)
+        return st
+
+    for r in range(B):
+        ref = one_row(r)
+        np.testing.assert_allclose(
+            np.asarray(out.re[r]), np.asarray(ref.re).reshape(-1), atol=1e-5
+        )
+
+
+def test_scanned_diag_runs_stack(tpu_form):
+    """Layer-varying diagonal runs chain into ONE stacked (L,2^n) mask."""
+    L = 2
+    rng = np.random.default_rng(8)
+    th = jnp.asarray(rng.uniform(-2, 2, (L,)), dtype=jnp.float32)
+    ops = [
+        fuse.Op("diag1", (2,), gates.rz_diag(th)),
+        fuse.Op("diag2", (3, 8), gates.cphase_diag(2 * th)),
+    ]
+    prog = fuse.fuse_ops_stacked(ops, N, L)
+    assert [o.kind for o in prog.body] == ["mask"]
+    assert prog.body[0].coeffs.re.shape == (L, 1 << N)
+    state = _rand_state(N, 11)
+    out = fuse.apply_scan(state, N, prog)
+    ref = state
+    for l in range(L):
+        ref = fuse.apply_ops_unfused(
+            ref,
+            [
+                fuse.Op("diag1", (2,), gates.rz_diag(th[l])),
+                fuse.Op("diag2", (3, 8), gates.cphase_diag(2 * th[l])),
+            ],
+        )
+    np.testing.assert_allclose(to_complex(out), to_complex(ref), atol=2e-5)
+
+
+def test_tree_product_state_matches_sequential():
+    from qfedx_tpu.ops.batched import bstate_product, bstate_product_tree
+
+    rng = np.random.default_rng(12)
+    for n in (3, 8, 12):
+        ang = rng.uniform(0, np.pi, (3, n))
+        amps = CArray(
+            jnp.asarray(
+                np.stack([np.cos(ang), np.sin(ang)], -1), dtype=jnp.float32
+            ),
+            None,
+        )
+        a, b = bstate_product(amps), bstate_product_tree(amps)
+        np.testing.assert_allclose(
+            np.asarray(a.re), np.asarray(b.re), atol=2e-6
+        )
+        assert b.im is None
+
+
+# --- model-level parity ------------------------------------------------------
+
+
+def _model(monkeypatch, encoding, n_layers=2, noise_model=None):
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    monkeypatch.setenv("QFEDX_BATCHED", "1")
+    return make_vqc_classifier(
+        n_qubits=N,
+        n_layers=n_layers,
+        num_classes=2,
+        encoding=encoding,
+        noise_model=noise_model,
+    )
+
+
+@pytest.mark.parametrize("encoding", ["angle", "reupload"])
+def test_model_scanned_parity(encoding, monkeypatch, tpu_form):
+    """Scanned ≡ fused logits AND gradients (batched engine + the
+    client-folded path). The pins are read at trace time, so each route
+    applies under its own pin window. Reupload scans layers 1..L−1, so
+    its model is one layer deeper for the route to engage."""
+    import optax
+
+    # reupload needs L−1 ≥ 2 for its scanned block stack
+    m = _model(monkeypatch, encoding, n_layers=3 if encoding == "reupload" else 2)
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.uniform(0, 1, (2, N)), dtype=jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, (2,)), dtype=jnp.int32)
+    params = m.init(jax.random.PRNGKey(0))
+
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "1")
+    a = m.apply(params, x)
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "0")
+    b = m.apply(params, x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5, rtol=0)
+
+    def loss(p):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            m.apply(p, x), y
+        ).mean()
+
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "1")
+    g1 = jax.grad(loss)(params)
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "0")
+    g0 = jax.grad(loss)(params)
+    for u, v in zip(jax.tree.leaves(g1), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(
+            np.asarray(u), np.asarray(v), atol=2e-5, rtol=0
+        )
+
+    # client-folded path: per-client stacks ride the scan too
+    cparams = jax.tree.map(
+        lambda p: p[None]
+        * (1.0 + 0.1 * jnp.arange(2).reshape((2,) + (1,) * p.ndim)),
+        params,
+    )
+    cx = jnp.stack([x, x * 0.9])
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "1")
+    fa = m.apply_clients(cparams, cx)
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "0")
+    fb = m.apply_clients(cparams, cx)
+    np.testing.assert_allclose(
+        np.asarray(fa), np.asarray(fb), atol=2e-5, rtol=0
+    )
+
+
+def test_model_scanned_parity_bf16(monkeypatch, tpu_form):
+    monkeypatch.setenv("QFEDX_DTYPE", "bf16")
+    m = _model(monkeypatch, "angle")
+    rng = np.random.default_rng(10)
+    x = jnp.asarray(rng.uniform(0, 1, (2, N)), dtype=jnp.float32)
+    params = m.init(jax.random.PRNGKey(1))
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "1")
+    a = np.asarray(m.apply(params, x))
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "0")
+    b = np.asarray(m.apply(params, x))
+    assert np.all(np.isfinite(a))
+    np.testing.assert_allclose(a, b, atol=3e-2, rtol=0)
+
+
+def test_noise_channels_are_scan_barriers(monkeypatch, tpu_form):
+    """Circuit-level Kraus noise keeps the per-layer loop (a channel
+    between layers is a scan barrier) and consumes the SAME PRNG
+    stream: scanned-pin and off trajectories coincide sample-for-
+    sample."""
+    from qfedx_tpu.noise import NoiseModel
+
+    nm = NoiseModel(depolarizing_p=0.1, circuit_level=True)
+    m = _model(monkeypatch, "angle", n_layers=2, noise_model=nm)
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.uniform(0, 1, (2, N)), dtype=jnp.float32)
+    params = m.init(jax.random.PRNGKey(2))
+    key = jax.random.PRNGKey(3)
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "1")
+    a = np.asarray(m.apply_train(params, x, key))
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "0")
+    b = np.asarray(m.apply_train(params, x, key))
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=0)
+
+
+def test_persistent_forward_routes_on_scan_pin(monkeypatch, tpu_form):
+    """The serving cache keys on QFEDX_SCAN_LAYERS: flipping the pin
+    around one facade compiles a SECOND route instead of serving the
+    stale program (serve/forward.py)."""
+    from qfedx_tpu.serve.forward import cached_routes, persistent_forward
+
+    m = _model(monkeypatch, "angle")
+    params = m.init(jax.random.PRNGKey(4))
+    x = jnp.zeros((2, N), dtype=jnp.float32)
+    fwd = persistent_forward(m.apply)
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "1")
+    fwd(params, x)
+    assert cached_routes(m.apply) == 1
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "0")
+    fwd(params, x)
+    assert cached_routes(m.apply) == 2
+
+
+# --- sharded engine ----------------------------------------------------------
+
+
+def test_sharded_scanned_parity(monkeypatch, tpu_form):
+    """The sharded layer loop scans with the body running the segment-
+    and-fuse pass once — parity vs the dense per-gate oracle on a
+    2-device sv mesh, with the scan route asserted engaged."""
+    from jax.sharding import Mesh
+
+    from qfedx_tpu.circuits.ansatz import (
+        hardware_efficient,
+        init_ansatz_params,
+    )
+    from qfedx_tpu.circuits.encoders import angle_encode
+    from qfedx_tpu.ops.statevector import expect_z_all
+    from qfedx_tpu.parallel.circuit import make_sharded_forward
+
+    n = 10
+    mesh = Mesh(np.array(jax.devices()[:2]), ("sv",))
+    params = init_ansatz_params(jax.random.PRNGKey(4), n, 2)
+    x = jnp.asarray(
+        np.random.default_rng(12).uniform(0, 1, (n,)), dtype=jnp.float32
+    )
+
+    scans = []
+    real = jax.lax.scan
+
+    def spy(*a, **k):
+        scans.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(jax.lax, "scan", spy)
+    fwd, ctx = make_sharded_forward(n, mesh)
+    sharded = np.asarray(fwd(params, x))
+    assert scans  # the layer loop really scanned
+
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "0")
+    monkeypatch.setenv("QFEDX_FUSE", "0")
+    dense = np.asarray(
+        expect_z_all(hardware_efficient(angle_encode(x, "ry"), params))
+    )
+    np.testing.assert_allclose(sharded, dense, atol=2e-5, rtol=0)

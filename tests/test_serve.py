@@ -134,6 +134,11 @@ def test_warmup_compiles_every_bucket_no_compile_in_loop(monkeypatch):
     engine, _, _ = _engine(buckets=(1, 2, 4), deadline_ms=30.0)
     warm = engine.warmup()
     assert set(warm["buckets"]) == {1, 2, 4}
+    # The resolved route answers scanned-vs-per-layer even when every
+    # raw pin is unset (r17): booleans + a concrete dtype name, never "".
+    assert isinstance(warm["route_resolved"]["fuse"], bool)
+    assert isinstance(warm["route_resolved"]["scan_layers"], bool)
+    assert warm["route_resolved"]["dtype"] in ("float32", "bfloat16")
 
     def compile_total():
         return sum(
