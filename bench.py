@@ -1837,6 +1837,32 @@ def main():
     except Exception as e:  # noqa: BLE001 — tracking must never kill bench
         vs_prev["error"] = f"{type(e).__name__}: {e}"
 
+    # Static-analysis artifact (r18, docs/ANALYSIS.md): the full
+    # `qfedx lint --json` report lands bench-adjacent (bench_lint.json)
+    # and the counts ride the details sidecar, so every bench run
+    # records the contract state it measured under; the vs-baseline
+    # delta prints as ONE line below.
+    try:
+        from qfedx_tpu.analysis import render_json, run_lint
+
+        _lint = run_lint()
+        lint_row = {
+            "ok": _lint.ok,
+            "counts_by_rule": _lint.counts_by_rule(),
+            "new": len(_lint.findings),
+            "baselined": len(_lint.baselined),
+            "suppressed": _lint.suppressed,
+            "stale_baseline": len(_lint.stale_baseline),
+            "delta": _lint.delta_line(),
+        }
+        with open(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "bench_lint.json"
+        ), "w") as f:
+            f.write(render_json(_lint))
+        print(lint_row["delta"])
+    except Exception as e:  # noqa: BLE001 — lint must never kill bench
+        lint_row = {"error": f"{type(e).__name__}: {e}"}
+
     details = {
         "metric": "vqc_client_rounds_per_sec_per_chip",
         "value": round(value, 3),
@@ -1876,6 +1902,7 @@ def main():
         "floor_attribution": floor_attr,
         "time_to_target": ttt,
         "time_to_target_20q": ttt20,
+        "lint": lint_row,
         "vs_prev": vs_prev,
     }
     sidecar = os.path.join(

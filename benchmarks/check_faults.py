@@ -1,87 +1,29 @@
 """Lint: the fault-site taxonomy in ``docs/ROBUSTNESS.md`` matches code.
 
-``utils/faults.py`` is the chaos harness's source of truth — its
-``SITES`` / ``CLIENT_KINDS`` / ``BYZANTINE_KINDS`` literals define what
-a ``FaultPlan`` can inject. An operator writing a plan reads the
-taxonomy table in ``docs/ROBUSTNESS.md`` ("## Fault-site taxonomy"), so
-a site or kind that exists in code but not in the table is invisible
-exactly the way an undocumented ``QFEDX_*`` pin is — this guard follows
-``check_pins.py``'s shape: single definition, wired as a tier-1 test
-(tests/test_check_pins.py) and runnable standalone (``python
-benchmarks/check_faults.py`` exits non-zero with offenders).
-
-Contract: the doc table has one row per site, first cell the backticked
-site name, second cell the backticked kind spellings — compared both
-directions against ``faults.doc_taxonomy()`` (missing row/kind fails,
-stale row/kind fails). ``doc_taxonomy`` is derived from the code
-tuples, so a new injection mode cannot ship without its documentation
-row.
+Rehosted (r18): the single definition now lives on the unified
+analysis engine — ``qfedx_tpu.analysis.rules_doc`` (rule **QFX102**
+under ``qfedx lint``; docs/ANALYSIS.md has the taxonomy). This wrapper
+keeps the historical surface alive verbatim for
+tests/test_check_pins.py and standalone runs. The contract is
+unchanged: ``utils/faults.doc_taxonomy()`` (derived from the
+``SITES``/``*_KINDS`` code tuples) vs the docs table, per site and per
+kind, both directions — a new injection mode cannot ship without its
+documentation row.
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 _REPO = Path(__file__).resolve().parent.parent
-_HEADING = "## Fault-site taxonomy"
-_ROW = re.compile(r"^\|\s*`([a-z0-9_.]+)`\s*\|([^|]*)\|")
-_TICKED = re.compile(r"`([^`]+)`")
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
 
-
-def documented_taxonomy(doc_path: str | Path | None = None) -> dict:
-    """``{site: (kinds...)}`` parsed from the taxonomy table rows under
-    the "## Fault-site taxonomy" heading (to the next heading)."""
-    path = Path(doc_path) if doc_path else _REPO / "docs" / "ROBUSTNESS.md"
-    out: dict[str, tuple[str, ...]] = {}
-    in_section = False
-    for line in path.read_text().splitlines():
-        stripped = line.strip()
-        if stripped.startswith("#"):
-            in_section = stripped.startswith(_HEADING)
-            continue
-        if not in_section:
-            continue
-        m = _ROW.match(stripped)
-        if m and m.group(1) != "site":  # skip a literal header row
-            out[m.group(1)] = tuple(_TICKED.findall(m.group(2)))
-    return out
-
-
-def check(doc_path: str | Path | None = None) -> list[str]:
-    """Problem strings (empty = clean): taxonomy drift in either
-    direction between utils/faults.py and docs/ROBUSTNESS.md."""
-    from qfedx_tpu.utils.faults import doc_taxonomy
-
-    code = doc_taxonomy()
-    doc = documented_taxonomy(doc_path)
-    problems = []
-    for site, kinds in sorted(code.items()):
-        if site not in doc:
-            problems.append(
-                f"fault site {site} (utils/faults.py) has no row in the "
-                "docs/ROBUSTNESS.md fault-site taxonomy table"
-            )
-            continue
-        missing = [k for k in kinds if k not in doc[site]]
-        if missing:
-            problems.append(
-                f"fault site {site}: kinds {missing} missing from its "
-                "docs/ROBUSTNESS.md taxonomy row"
-            )
-        stale = [k for k in doc[site] if k not in kinds]
-        if stale:
-            problems.append(
-                f"fault site {site}: taxonomy row lists {stale}, not in "
-                "utils/faults.py (stale doc kinds?)"
-            )
-    for site in sorted(set(doc) - set(code)):
-        problems.append(
-            f"taxonomy row {site} matches no site in utils/faults.py "
-            "(stale doc row?)"
-        )
-    return problems
+from qfedx_tpu.analysis.rules_doc import (  # noqa: E402,F401
+    check_faults as check,
+    documented_taxonomy,
+)
 
 
 def main() -> int:
@@ -98,6 +40,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    if str(_REPO) not in sys.path:
-        sys.path.insert(0, str(_REPO))
     sys.exit(main())

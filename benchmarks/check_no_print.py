@@ -1,50 +1,28 @@
 """Lint: no bare ``print()`` inside ``qfedx_tpu/`` outside the CLI/demo.
 
-Telemetry goes through ``obs`` (spans/counters) and ``run/metrics``
-(JSONL artifacts); progress text goes through the primary-gated ``say``
-in ``run/cli.py``. A stray ``print`` in library code interleaves across
-multi-host pods (utils/host.py docstring) and is invisible to every
-exporter — the reference's whole observability story was prints, which
-is exactly what this repo replaces (run/metrics.py docstring).
-
-AST-based (string literals and docstrings mentioning print are fine);
-wired as a tier-1 test in tests/test_no_print.py and runnable
-standalone: ``python benchmarks/check_no_print.py`` exits non-zero with
-offender ``path:line`` lines.
+Rehosted (r18): the single definition now lives on the unified
+analysis engine — ``qfedx_tpu.analysis.rules_prints`` (rule **QFX105**
+under ``qfedx lint``; docs/ANALYSIS.md has the taxonomy). This wrapper
+keeps the historical surface alive verbatim for tests/test_no_print.py
+and standalone runs. The contract is unchanged: telemetry goes through
+``obs`` and ``run/metrics``, progress text through the primary-gated
+``say`` — a stray library ``print`` interleaves across multi-host pods
+and reaches no exporter.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-# Files whose job is terminal output: the argparse CLI (primary-gated
-# ``say``) and the walkthrough demo script.
-ALLOWED = {"run/cli.py", "run/demo.py"}
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
 
-
-def find_prints(package_root: str | Path | None = None) -> list[str]:
-    """``["rel/path.py:lineno", ...]`` of bare print() calls under
-    ``package_root`` (default: the qfedx_tpu package next to this
-    repo's benchmarks/), excluding ALLOWED."""
-    if package_root is None:
-        package_root = Path(__file__).resolve().parent.parent / "qfedx_tpu"
-    root = Path(package_root)
-    offenders: list[str] = []
-    for py in sorted(root.rglob("*.py")):
-        rel = py.relative_to(root).as_posix()
-        if rel in ALLOWED or "__pycache__" in rel:
-            continue
-        tree = ast.parse(py.read_text(), filename=str(py))
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "print"
-            ):
-                offenders.append(f"{rel}:{node.lineno}")
-    return offenders
+from qfedx_tpu.analysis.rules_prints import (  # noqa: E402,F401
+    ALLOWED,
+    find_prints,
+)
 
 
 def main() -> int:

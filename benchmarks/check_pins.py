@@ -1,88 +1,32 @@
 """Lint: every ``QFEDX_*`` pin read in ``qfedx_tpu/`` is documented.
 
-The pin table in ``docs/OBSERVABILITY.md`` ("The ``QFEDX_*`` pin family
-(one table)") is the contract surface for every env knob the framework
-reads — values, defaults, read time, effect. A pin that exists in source
-but not in the table is invisible to operators exactly the way a bare
-print() is invisible to exporters, so this guard follows
-``check_no_print.py``'s shape: AST-based single definition, wired as a
-tier-1 test (tests/test_check_pins.py) and runnable standalone
-(``python benchmarks/check_pins.py`` exits non-zero with offenders).
-
-Detection: an exact string literal ``"QFEDX_..."`` anywhere in package
-code IS a pin reference (``pins.bool_pin("QFEDX_HIER", ...)``,
-``os.environ.get("QFEDX_TRACE")``, ``{"QFEDX_DTYPE": "bf16"}`` — every
-read/write spelling funnels through such a literal; prose only ever
-embeds pin names inside longer strings, which full-match filtering
-ignores). The check runs both directions: source pins missing from the
-table fail, and table rows whose pin no longer appears in source fail
-too — a stale row misdocuments the system as surely as a missing one.
+Rehosted (r18): the single definition now lives on the unified
+analysis engine — ``qfedx_tpu.analysis.rules_pins`` (rule **QFX101**
+under ``qfedx lint``; docs/ANALYSIS.md has the taxonomy). This wrapper
+keeps the historical surface alive verbatim: the tier-1 test
+(tests/test_check_pins.py) imports ``check``/``source_pins``/
+``documented_pins`` from here, and ``python benchmarks/check_pins.py``
+still exits non-zero with offenders. The contract itself is unchanged:
+an exact ``"QFEDX_..."`` string literal in package code IS a pin
+reference, and the docs/OBSERVABILITY.md pin table must match it in
+both directions (a stale row misdocuments the system as surely as a
+missing one).
 """
 
 from __future__ import annotations
 
-import ast
-import re
 import sys
 from pathlib import Path
 
-_PIN_LITERAL = re.compile(r"QFEDX_[A-Z0-9_]+\Z")
-_TABLE_ROW = re.compile(r"^\|\s*`(QFEDX_[A-Z0-9_]+)`")
-
 _REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
 
-
-def source_pins(package_root: str | Path | None = None) -> dict[str, list[str]]:
-    """``{pin_name: ["rel/path.py:lineno", ...]}`` for every exact
-    ``QFEDX_*`` string literal in package code."""
-    root = Path(package_root) if package_root else _REPO / "qfedx_tpu"
-    pins: dict[str, list[str]] = {}
-    for py in sorted(root.rglob("*.py")):
-        rel = py.relative_to(root).as_posix()
-        if "__pycache__" in rel:
-            continue
-        tree = ast.parse(py.read_text(), filename=str(py))
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Constant)
-                and isinstance(node.value, str)
-                and _PIN_LITERAL.fullmatch(node.value)
-            ):
-                pins.setdefault(node.value, []).append(f"{rel}:{node.lineno}")
-    return pins
-
-
-def documented_pins(doc_path: str | Path | None = None) -> set[str]:
-    """Pin names with a row in the OBSERVABILITY.md pin table."""
-    path = Path(doc_path) if doc_path else _REPO / "docs" / "OBSERVABILITY.md"
-    names = set()
-    for line in path.read_text().splitlines():
-        m = _TABLE_ROW.match(line.strip())
-        if m:
-            names.add(m.group(1))
-    return names
-
-
-def check(
-    package_root: str | Path | None = None,
-    doc_path: str | Path | None = None,
-) -> list[str]:
-    """Problem strings (empty = clean): undocumented source pins and
-    stale table rows."""
-    pins = source_pins(package_root)
-    documented = documented_pins(doc_path)
-    problems = [
-        f"pin {name} read at {', '.join(sites)} has no row in the "
-        "docs/OBSERVABILITY.md pin table"
-        for name, sites in sorted(pins.items())
-        if name not in documented
-    ]
-    problems += [
-        f"pin table row {name} matches no QFEDX_* literal in qfedx_tpu/ "
-        "(stale doc row?)"
-        for name in sorted(documented - set(pins))
-    ]
-    return problems
+from qfedx_tpu.analysis.rules_pins import (  # noqa: E402,F401
+    check,
+    documented_pins,
+    source_pins,
+)
 
 
 def main() -> int:

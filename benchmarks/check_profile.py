@@ -1,77 +1,28 @@
 """Lint: the ``profile_summary.json`` schema and its docs table agree.
 
-``qfedx_tpu/obs/profile.py`` writes ``profile_summary.json`` with
-exactly the ``SUMMARY_FIELDS`` keys; the schema table in
-``docs/OBSERVABILITY.md`` ("## The ``profile_summary.json`` schema") is
-the operator-facing contract for those fields. A field emitted without
-a doc row is invisible to readers exactly the way an undocumented
-QFEDX_* pin is, and a stale row misdocuments the artifact — so this
-guard follows ``check_pins.py`` / ``check_spans.py``'s shape: single
-definition, both directions, wired as a tier-1 test
-(tests/test_check_pins.py) and runnable standalone (``python
-benchmarks/check_profile.py`` exits non-zero with offenders).
+Rehosted (r18): the single definition now lives on the unified
+analysis engine — ``qfedx_tpu.analysis.rules_doc`` (rule **QFX104**
+under ``qfedx lint``; docs/ANALYSIS.md has the taxonomy). This wrapper
+keeps the historical surface alive verbatim for
+tests/test_check_pins.py and standalone runs. The contract is
+unchanged: ``obs/profile.py``'s ``SUMMARY_FIELDS`` vs the
+docs/OBSERVABILITY.md schema table, both directions.
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
-_TABLE_ROW = re.compile(r"^\|\s*`([a-z0-9_]+)`")
-_HEADING = "## The `profile_summary.json` schema"
-
 _REPO = Path(__file__).resolve().parent.parent
-
-
-def source_fields() -> set[str]:
-    """The field names ``obs.profile.summarize`` emits — the
-    SUMMARY_FIELDS contract (summarize() builds exactly these keys;
-    tests/test_obs.py pins that equality on a real summary)."""
+if str(_REPO) not in sys.path:
     sys.path.insert(0, str(_REPO))
-    from qfedx_tpu.obs.profile import SUMMARY_FIELDS
 
-    return set(SUMMARY_FIELDS)
-
-
-def documented_fields(doc_path: str | Path | None = None) -> set[str]:
-    """Field names with a row in the OBSERVABILITY.md schema table
-    (rows under the schema heading, to the next heading)."""
-    path = Path(doc_path) if doc_path else _REPO / "docs" / "OBSERVABILITY.md"
-    names = set()
-    in_section = False
-    for line in path.read_text().splitlines():
-        stripped = line.strip()
-        if stripped.startswith("#"):
-            in_section = stripped.startswith(_HEADING)
-            continue
-        if not in_section:
-            continue
-        m = _TABLE_ROW.match(stripped)
-        if m and m.group(1) != "field":  # skip a literal header row
-            names.add(m.group(1))
-    return names
-
-
-def check(
-    doc_path: str | Path | None = None, fields: set[str] | None = None
-) -> list[str]:
-    """Problem strings (empty = clean): undocumented summary fields and
-    stale schema-table rows."""
-    fields = source_fields() if fields is None else set(fields)
-    documented = documented_fields(doc_path)
-    problems = [
-        f"profile_summary.json field {name!r} (obs/profile.py "
-        "SUMMARY_FIELDS) has no row in the docs/OBSERVABILITY.md "
-        "schema table"
-        for name in sorted(fields - documented)
-    ]
-    problems += [
-        f"schema-table row {name!r} matches no SUMMARY_FIELDS entry in "
-        "obs/profile.py (stale doc row?)"
-        for name in sorted(documented - fields)
-    ]
-    return problems
+from qfedx_tpu.analysis.rules_doc import (  # noqa: E402,F401
+    check_profile as check,
+    documented_fields,
+    source_fields,
+)
 
 
 def main() -> int:

@@ -1,106 +1,31 @@
 """Lint: every span-name literal in ``qfedx_tpu/`` is in the taxonomy.
 
-The span taxonomy table in ``docs/OBSERVABILITY.md`` ("## Span
-taxonomy") is the contract surface for every phase name the framework
-records — an operator reading a trace.json or a /metrics scrape looks
-names up there. A span that exists in source but not in the table is
-invisible exactly the way an undocumented QFEDX_* pin is, so this guard
-follows ``check_pins.py``'s shape: AST-based single definition, wired
-as a tier-1 test (tests/test_check_pins.py) and runnable standalone
-(``python benchmarks/check_spans.py`` exits non-zero with offenders).
-
-Detection: a string literal appearing as the FIRST argument of a
-``span(...)`` / ``obs.span(...)`` call in package code IS a span name
-(every recording site spells it that way; dynamic names would defeat
-the taxonomy and none exist). The check runs both directions: source
-spans missing from the table fail, and table rows whose span no longer
-appears in source fail too — a stale row misdocuments the system as
-surely as a missing one. It caught the r15 ``obs.http`` span before
-its row existed, which is the point.
+Rehosted (r18): the single definition now lives on the unified
+analysis engine — ``qfedx_tpu.analysis.rules_spans`` (rule **QFX103**
+under ``qfedx lint``, which also adds the QFX003 span-LEAK analysis;
+docs/ANALYSIS.md has the taxonomy). This wrapper keeps the historical
+surface alive verbatim for tests/test_check_pins.py and standalone
+runs. The contract is unchanged: a string literal as the FIRST
+argument of a ``span(...)`` call IS a span name, and the
+docs/OBSERVABILITY.md "## Span taxonomy" table must match source in
+both directions. It caught the r15 ``obs.http`` span before its row
+existed, which is the point.
 """
 
 from __future__ import annotations
 
-import ast
-import re
 import sys
 from pathlib import Path
 
-_TABLE_ROW = re.compile(r"^\|\s*`([a-z0-9_.]+)`")
-_HEADING = "## Span taxonomy"
-
 _REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
 
-
-def source_spans(package_root: str | Path | None = None) -> dict[str, list[str]]:
-    """``{span_name: ["rel/path.py:lineno", ...]}`` for every
-    ``span("name", ...)`` call site in package code."""
-    root = Path(package_root) if package_root else _REPO / "qfedx_tpu"
-    spans: dict[str, list[str]] = {}
-    for py in sorted(root.rglob("*.py")):
-        rel = py.relative_to(root).as_posix()
-        if "__pycache__" in rel:
-            continue
-        tree = ast.parse(py.read_text(), filename=str(py))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call) or not node.args:
-                continue
-            fn = node.func
-            name = (
-                fn.attr if isinstance(fn, ast.Attribute)
-                else fn.id if isinstance(fn, ast.Name)
-                else None
-            )
-            if name != "span":
-                continue
-            first = node.args[0]
-            if isinstance(first, ast.Constant) and isinstance(first.value, str):
-                spans.setdefault(first.value, []).append(
-                    f"{rel}:{node.lineno}"
-                )
-    return spans
-
-
-def documented_spans(doc_path: str | Path | None = None) -> set[str]:
-    """Span names with a row in the OBSERVABILITY.md span-taxonomy
-    table (rows under the "## Span taxonomy" heading, to the next
-    heading)."""
-    path = Path(doc_path) if doc_path else _REPO / "docs" / "OBSERVABILITY.md"
-    names = set()
-    in_section = False
-    for line in path.read_text().splitlines():
-        stripped = line.strip()
-        if stripped.startswith("#"):
-            in_section = stripped.startswith(_HEADING)
-            continue
-        if not in_section:
-            continue
-        m = _TABLE_ROW.match(stripped)
-        if m and m.group(1) != "span":  # skip a literal header row
-            names.add(m.group(1))
-    return names
-
-
-def check(
-    package_root: str | Path | None = None,
-    doc_path: str | Path | None = None,
-) -> list[str]:
-    """Problem strings (empty = clean): undocumented source spans and
-    stale taxonomy rows."""
-    spans = source_spans(package_root)
-    documented = documented_spans(doc_path)
-    problems = [
-        f"span {name!r} recorded at {', '.join(sites)} has no row in "
-        "the docs/OBSERVABILITY.md span-taxonomy table"
-        for name, sites in sorted(spans.items())
-        if name not in documented
-    ]
-    problems += [
-        f"span-taxonomy row {name!r} matches no span literal in "
-        "qfedx_tpu/ (stale doc row?)"
-        for name in sorted(documented - set(spans))
-    ]
-    return problems
+from qfedx_tpu.analysis.rules_spans import (  # noqa: E402,F401
+    check,
+    documented_spans,
+    source_spans,
+)
 
 
 def main() -> int:

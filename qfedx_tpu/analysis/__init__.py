@@ -1,0 +1,53 @@
+"""`qfedx lint` — the unified AST static-analysis engine.
+
+Every headline guarantee in this repo rests on invariants the test
+suite can only *sample*: traced functions must be pure (SA mask
+cancellation and bit-exact parity die on host time/randomness inside a
+trace), `QFEDX_*` pins must funnel through ``utils/pins`` and be
+documented (the wrong-path-measured error class, ADVICE r04), spans
+must close, shared instrument state must stay under its lock, and
+donated buffers must not be read after the dispatch that consumed
+them. Five ad-hoc ``benchmarks/check_*.py`` scripts each reimplemented
+a sliver of this (file walking, doc-table parsing, AST scanning); this
+package replaces the slivers with ONE engine:
+
+- ``loader``      — parse the tree once into parent-annotated ASTs,
+                    with per-line ``qfedx: ignore[<rule>]`` suppressions
+- ``callgraph``   — who calls whom, who is traced (jit/scan/vmap/
+                    shard_map roots), reachability with witness paths
+- ``engine``      — rule registry (stable IDs), baseline file for
+                    grandfathered findings, text + JSON reports
+- ``rules_*``     — QFX001–QFX005 (new analyses) and QFX100–QFX105
+                    (the rehosted doc-taxonomy/contract guards)
+
+Entry points: ``qfedx lint`` (run/cli.py), the tier-1 gate
+(tests/test_lint.py), and the thin ``benchmarks/check_*.py`` wrappers
+that keep the historical script/test surface alive. docs/ANALYSIS.md
+is the operator contract — its rule-taxonomy table is enforced in both
+directions by rule QFX100, the same house style as the pin table.
+
+Import-light on purpose (stdlib only at import time): ``qfedx lint``
+answers in a couple of seconds and never initializes a JAX backend.
+"""
+
+from qfedx_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    LintResult,
+    all_rules,
+    render_json,
+    render_text,
+    run_lint,
+)
+from qfedx_tpu.analysis.config import LintConfig, load_config  # noqa: F401
+
+# Importing the rule modules registers them (engine.register at module
+# scope) — the registry is populated exactly once, at package import.
+from qfedx_tpu.analysis import (  # noqa: F401, E402
+    rules_doc,
+    rules_donation,
+    rules_locks,
+    rules_pins,
+    rules_prints,
+    rules_purity,
+    rules_spans,
+)

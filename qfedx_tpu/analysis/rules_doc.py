@@ -1,0 +1,274 @@
+"""QFX100/QFX102/QFX104 — the remaining doc-taxonomy contracts.
+
+**QFX100 (rule-taxonomy).** The engine eats its own dogfood: every
+registered rule ID needs a row in docs/ANALYSIS.md's "## Rule
+taxonomy" table, and every row must name a registered rule — the same
+both-directions discipline the pin table established (a lint rule
+nobody can look up is as invisible as an undocumented pin; a row for
+a deleted rule misdocuments the guarantees).
+
+**QFX102 (fault-taxonomy, rehosted check_faults).** ``utils/faults``'s
+``doc_taxonomy()`` (derived from the ``SITES``/``*_KINDS`` code
+tuples) vs the docs/ROBUSTNESS.md "## Fault-site taxonomy" table, per
+site and per kind, both directions.
+
+**QFX104 (profile-schema, rehosted check_profile).**
+``obs/profile.py``'s ``SUMMARY_FIELDS`` vs the docs/OBSERVABILITY.md
+"## The `profile_summary.json` schema" table, both directions.
+
+The two rehosted rules import their source-of-truth modules lazily
+inside ``run`` — ``qfedx lint`` must not pay a JAX import when those
+rules are deselected, and must degrade loudly (a finding, not a
+crash) if the contract surface moved.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from qfedx_tpu.analysis import engine as _engine
+from qfedx_tpu.analysis.engine import Finding, LintContext, Rule, register
+
+RULE_DOC = "docs/ANALYSIS.md"
+_RULE_HEADING = "## Rule taxonomy"
+_RULE_ROW = re.compile(r"^\|\s*`(QFX[0-9]{3})`")
+
+FAULT_DOC = "docs/ROBUSTNESS.md"
+_FAULT_HEADING = "## Fault-site taxonomy"
+_FAULT_ROW = re.compile(r"^\|\s*`([a-z0-9_.]+)`\s*\|([^|]*)\|")
+_TICKED = re.compile(r"`([^`]+)`")
+
+PROFILE_DOC = "docs/OBSERVABILITY.md"
+_PROFILE_HEADING = "## The `profile_summary.json` schema"
+_PROFILE_ROW = re.compile(r"^\|\s*`([a-z0-9_]+)`")
+
+
+def _default_repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+def _section_rows(
+    path: Path, heading: str, row_re: re.Pattern, skip: str | None = None
+) -> dict[str, int]:
+    """``{first_cell: line}`` for table rows under ``heading`` (to the
+    next heading)."""
+    rows: dict[str, int] = {}
+    in_section = False
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        stripped = line.strip()
+        if stripped.startswith("#"):
+            in_section = stripped.startswith(heading)
+            continue
+        if not in_section:
+            continue
+        m = row_re.match(stripped)
+        if m and m.group(1) != skip:
+            rows.setdefault(m.group(1), i)
+    return rows
+
+
+# -- QFX100 --------------------------------------------------------------------
+
+
+def documented_rules(doc_path: str | Path | None = None) -> dict[str, int]:
+    path = Path(doc_path) if doc_path else _default_repo_root() / RULE_DOC
+    if not path.exists():
+        return {}
+    return _section_rows(path, _RULE_HEADING, _RULE_ROW, skip=None)
+
+
+def _run_rule_taxonomy(ctx: LintContext) -> list[Finding]:
+    out: list[Finding] = []
+    doc = ctx.doc(RULE_DOC)
+    rows = documented_rules(doc)
+    registered = _engine.all_rules()
+    if not doc.exists():
+        return [Finding(
+            "QFX100", RULE_DOC, 1,
+            f"{RULE_DOC} is missing — the rule-taxonomy table is the "
+            "operator contract for every lint rule",
+        )]
+    for rid in sorted(registered):
+        if rid not in rows:
+            out.append(Finding(
+                "QFX100", RULE_DOC, 1,
+                f"rule {rid} ({registered[rid].title}) has no row in "
+                f"the {RULE_DOC} rule-taxonomy table",
+            ))
+    for rid, line in sorted(rows.items()):
+        if rid not in registered:
+            out.append(Finding(
+                "QFX100", RULE_DOC, line,
+                f"rule-taxonomy row {rid} matches no registered rule "
+                "(stale doc row?)",
+            ))
+    return out
+
+
+register(Rule(
+    "QFX100", "rule-taxonomy",
+    "every registered lint rule has a docs/ANALYSIS.md taxonomy row "
+    "and every row names a live rule (both directions)",
+    _run_rule_taxonomy,
+))
+
+
+# -- QFX102 (rehosted check_faults) --------------------------------------------
+
+
+def documented_taxonomy(doc_path: str | Path | None = None) -> dict:
+    """``{site: (kinds...)}`` parsed from the docs/ROBUSTNESS.md
+    fault-site table — the historical check_faults surface."""
+    path = Path(doc_path) if doc_path else _default_repo_root() / FAULT_DOC
+    out: dict[str, tuple[str, ...]] = {}
+    in_section = False
+    for line in path.read_text().splitlines():
+        stripped = line.strip()
+        if stripped.startswith("#"):
+            in_section = stripped.startswith(_FAULT_HEADING)
+            continue
+        if not in_section:
+            continue
+        m = _FAULT_ROW.match(stripped)
+        if m and m.group(1) != "site":  # skip a literal header row
+            out[m.group(1)] = tuple(_TICKED.findall(m.group(2)))
+    return out
+
+
+def check_faults(doc_path: str | Path | None = None) -> list[str]:
+    """Problem strings (empty = clean) — the historical check_faults
+    surface, kept for its tests and standalone runs."""
+    from qfedx_tpu.utils.faults import doc_taxonomy
+
+    code = doc_taxonomy()
+    doc = documented_taxonomy(doc_path)
+    problems = []
+    for site, kinds in sorted(code.items()):
+        if site not in doc:
+            problems.append(
+                f"fault site {site} (utils/faults.py) has no row in the "
+                "docs/ROBUSTNESS.md fault-site taxonomy table"
+            )
+            continue
+        missing = [k for k in kinds if k not in doc[site]]
+        if missing:
+            problems.append(
+                f"fault site {site}: kinds {missing} missing from its "
+                "docs/ROBUSTNESS.md taxonomy row"
+            )
+        stale = [k for k in doc[site] if k not in kinds]
+        if stale:
+            problems.append(
+                f"fault site {site}: taxonomy row lists {stale}, not in "
+                "utils/faults.py (stale doc kinds?)"
+            )
+    for site in sorted(set(doc) - set(code)):
+        problems.append(
+            f"taxonomy row {site} matches no site in utils/faults.py "
+            "(stale doc row?)"
+        )
+    return problems
+
+
+def _run_fault_taxonomy(ctx: LintContext) -> list[Finding]:
+    doc = ctx.doc(FAULT_DOC)
+    if not doc.exists():
+        return [Finding(
+            "QFX102", FAULT_DOC, 1,
+            f"{FAULT_DOC} is missing — the fault-site taxonomy is the "
+            "operator contract for FaultPlan",
+        )]
+    try:
+        problems = check_faults(doc)
+    except Exception as exc:  # noqa: BLE001 — a moved surface is a finding
+        return [Finding(
+            "QFX102", FAULT_DOC, 1,
+            f"fault-taxonomy source unavailable: {exc}",
+        )]
+    rows = _section_rows(doc, _FAULT_HEADING, _FAULT_ROW, skip="site")
+    out = []
+    for p in problems:
+        # anchor on the doc row when the problem names a known site
+        line = next(
+            (ln for site, ln in rows.items() if site in p), 1
+        )
+        out.append(Finding("QFX102", FAULT_DOC, line, p))
+    return out
+
+
+register(Rule(
+    "QFX102", "fault-taxonomy",
+    "utils/faults injection sites+kinds and the docs/ROBUSTNESS.md "
+    "taxonomy table agree (both directions)",
+    _run_fault_taxonomy,
+))
+
+
+# -- QFX104 (rehosted check_profile) -------------------------------------------
+
+
+def source_fields() -> set[str]:
+    """The field names ``obs.profile.summarize`` emits — the
+    SUMMARY_FIELDS contract."""
+    from qfedx_tpu.obs.profile import SUMMARY_FIELDS
+
+    return set(SUMMARY_FIELDS)
+
+
+def documented_fields(doc_path: str | Path | None = None) -> set[str]:
+    path = Path(doc_path) if doc_path else _default_repo_root() / PROFILE_DOC
+    return set(_section_rows(path, _PROFILE_HEADING, _PROFILE_ROW,
+                             skip="field"))
+
+
+def check_profile(
+    doc_path: str | Path | None = None, fields: set[str] | None = None
+) -> list[str]:
+    """Problem strings (empty = clean) — the historical check_profile
+    surface."""
+    fields = source_fields() if fields is None else set(fields)
+    documented = documented_fields(doc_path)
+    problems = [
+        f"profile_summary.json field {name!r} (obs/profile.py "
+        "SUMMARY_FIELDS) has no row in the docs/OBSERVABILITY.md "
+        "schema table"
+        for name in sorted(fields - documented)
+    ]
+    problems += [
+        f"schema-table row {name!r} matches no SUMMARY_FIELDS entry in "
+        "obs/profile.py (stale doc row?)"
+        for name in sorted(documented - fields)
+    ]
+    return problems
+
+
+def _run_profile_schema(ctx: LintContext) -> list[Finding]:
+    doc = ctx.doc(PROFILE_DOC)
+    if not doc.exists():
+        return [Finding(
+            "QFX104", PROFILE_DOC, 1,
+            f"{PROFILE_DOC} is missing — it carries the "
+            "profile_summary.json schema table",
+        )]
+    try:
+        problems = check_profile(doc)
+    except Exception as exc:  # noqa: BLE001 — a moved surface is a finding
+        return [Finding(
+            "QFX104", PROFILE_DOC, 1,
+            f"profile-schema source unavailable: {exc}",
+        )]
+    rows = _section_rows(doc, _PROFILE_HEADING, _PROFILE_ROW, skip="field")
+    out = []
+    for p in problems:
+        line = next((ln for f, ln in rows.items() if f"'{f}'" in p), 1)
+        out.append(Finding("QFX104", PROFILE_DOC, line, p))
+    return out
+
+
+register(Rule(
+    "QFX104", "profile-schema",
+    "obs/profile SUMMARY_FIELDS and the docs/OBSERVABILITY.md "
+    "profile_summary.json schema table agree (both directions)",
+    _run_profile_schema,
+))

@@ -1,0 +1,222 @@
+"""QFX005 — donation-after-use: a donated buffer must not be read back.
+
+``jax.jit(..., donate_argnums=(0,))`` lets XLA write the output over
+the input's buffer — the r09 pipeline's per-chunk params copy killer —
+but it DELETES the caller's array: touching the donated argument after
+the dispatch raises (best case) or reads freed memory semantics the
+runtime merely happens to tolerate (worst case, and the one that
+shifts with jax versions). The rule finds, per function scope:
+
+1. **Donating callables**: a name bound to a call carrying a
+   ``donate_argnums=`` keyword (non-empty literal tuple, or a variable
+   — conservatively *maybe donating*) or a ``donate=`` keyword that is
+   not the literal ``False`` (the repo's ``make_fed_round(...,
+   donate=...)`` builders).
+2. **Use after dispatch**: a later read of the Name passed in a
+   donated position — unless that very call's assignment rebinds the
+   name (the ``params, stats = round_fn(params, ...)`` chaining
+   idiom, which is exactly how donation is meant to be used), or the
+   name is reassigned in between.
+3. **Loop aliasing**: when the donating call sits in a loop, an alias
+   of the donated name created in the same loop (``ref = params`` /
+   ``ref = params if c else None``) outlives the iteration while the
+   next dispatch consumes the buffer it points at. The repo's
+   mitigation is a device-side ``jnp.copy`` snapshot; sites that do
+   that carry a suppression explaining it, so the hazard stays
+   visible at the line instead of silently assumed safe.
+
+Donated indices default to ``{0}`` when not statically readable — θ
+is argument 0 in every donating builder this repo has.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from qfedx_tpu.analysis.engine import Finding, LintContext, Rule, register
+from qfedx_tpu.analysis.loader import Module
+
+
+def _donation_indices(call: ast.Call) -> set[int] | None:
+    """Donated positional indices if ``call`` creates a donating
+    callable, else None. ``set()`` is never returned — a statically
+    empty donate list means "not donating"."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                idxs = {
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)
+                }
+                return idxs or None
+            if isinstance(v, ast.Constant):
+                return {v.value} if isinstance(v.value, int) else None
+            return {0}  # a variable: maybe-donating, assume θ at 0
+        if kw.arg == "donate" and not (
+            isinstance(kw.value, ast.Constant) and kw.value.value is False
+        ):
+            return {0}
+    return None
+
+
+def _scopes(mod: Module):
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _enclosing_loop(node: ast.AST, stop: ast.AST) -> ast.AST | None:
+    cur = getattr(node, "parent", None)
+    while cur is not None and cur is not stop:
+        if isinstance(cur, (ast.For, ast.While)):
+            return cur
+        cur = getattr(cur, "parent", None)
+    return None
+
+
+def _direct_children_scopes(fn: ast.AST) -> set[int]:
+    """ids of nodes belonging to NESTED function scopes (excluded from
+    this scope's analysis)."""
+    out: set[int] = set()
+    for node in ast.walk(fn):
+        if node is fn:
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            for sub in ast.walk(node):
+                out.add(id(sub))
+    return out
+
+
+def donation_hazards(mod: Module) -> list[tuple[int, str]]:
+    out: list[tuple[int, str]] = []
+    for fn in _scopes(mod):
+        nested = _direct_children_scopes(fn)
+        # donating-callable names bound in this scope
+        donating: dict[str, set[int]] = {}
+        for node in ast.walk(fn):
+            if id(node) in nested or not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            idxs = _donation_indices(node.value)
+            if idxs is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    donating[t.id] = idxs
+        if not donating:
+            continue
+
+        # dispatch sites: calls to a donating name with a Name in a
+        # donated position
+        for node in ast.walk(fn):
+            if id(node) in nested or not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Name):
+                continue
+            idxs = donating.get(node.func.id)
+            if idxs is None:
+                continue
+            donated_names = {
+                node.args[i].id
+                for i in idxs
+                if i < len(node.args) and isinstance(node.args[i], ast.Name)
+            }
+            if not donated_names:
+                continue
+            stmt = node
+            while not isinstance(stmt, ast.stmt):
+                stmt = stmt.parent  # type: ignore[attr-defined]
+            rebound: set[str] = set()
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    for tn in ast.walk(t):
+                        if isinstance(tn, ast.Name):
+                            rebound.add(tn.id)
+            loop = _enclosing_loop(node, fn)
+
+            for name in donated_names:
+                if name in rebound:
+                    # `x, stats = f(x, ...)`: the chaining idiom — the
+                    # direct after-use hazard is gone. Loop aliasing is
+                    # checked below regardless.
+                    pass
+                else:
+                    # textual after-use in the same scope
+                    for later in ast.walk(fn):
+                        if id(later) in nested:
+                            continue
+                        if (
+                            isinstance(later, ast.Name)
+                            and later.id == name
+                            and isinstance(later.ctx, ast.Load)
+                            and later.lineno > node.lineno
+                        ):
+                            out.append((
+                                later.lineno,
+                                f"'{name}' read after being donated to "
+                                f"'{node.func.id}' at line {node.lineno} "
+                                "— the dispatch consumed its buffer",
+                            ))
+                            break
+                if loop is not None:
+                    # alias created in the same loop body: `ref = x` /
+                    # `ref = x if c else None` — survives into the next
+                    # iteration, where the dispatch re-donates x
+                    for other in ast.walk(loop):
+                        if id(other) in nested:
+                            continue
+                        if (
+                            isinstance(other, ast.Assign)
+                            and not isinstance(other.value, ast.Call)
+                            and any(
+                                isinstance(n, ast.Name) and n.id == name
+                                and isinstance(n.ctx, ast.Load)
+                                for n in ast.walk(other.value)
+                            )
+                            and not any(
+                                isinstance(t, ast.Name) and t.id == name
+                                for t in other.targets
+                            )
+                        ):
+                            tgt = next(
+                                (t.id for t in other.targets
+                                 if isinstance(t, ast.Name)), "?",
+                            )
+                            out.append((
+                                other.lineno,
+                                f"alias '{tgt}' of '{name}' created in "
+                                "the loop that donates it to "
+                                f"'{node.func.id}' (line {node.lineno}) "
+                                "— next iteration's dispatch consumes "
+                                "the aliased buffer; snapshot "
+                                "(jnp.copy) before the donating call "
+                                "if the alias must outlive it",
+                            ))
+    # dedup (an alias can be reported once per dispatch site)
+    seen: set[tuple[int, str]] = set()
+    uniq = []
+    for item in out:
+        if item not in seen:
+            seen.add(item)
+            uniq.append(item)
+    return uniq
+
+
+def _run(ctx: LintContext) -> list[Finding]:
+    out: list[Finding] = []
+    for rel, mod in sorted(ctx.modules.items()):
+        for lineno, msg in donation_hazards(mod):
+            out.append(Finding("QFX005", rel, lineno, msg))
+    return out
+
+
+register(Rule(
+    "QFX005", "donation-after-use",
+    "no donated θ buffer is referenced after the dispatch that "
+    "consumed it (donate_argnums deletes the caller's array)",
+    _run,
+))

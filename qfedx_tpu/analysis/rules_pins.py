@@ -1,0 +1,206 @@
+"""QFX002 — raw pin reads; QFX101 — the pin table contract.
+
+**QFX002 (raw-pin-read).** Every ``os.environ`` / ``os.getenv`` use
+outside ``utils/pins.py`` is a finding. The pin module is THE env
+funnel: it owns the on/off grammar, the loud-typo contract (a
+misspelled value must raise, never silently route the other path —
+ADVICE r04's wrong-path-measured class), and the trace-time read
+discipline. A raw read elsewhere re-opens exactly the drift the
+funnel closed (by r09, five hand-rolled parsers had already diverged
+on case handling). Intentional raw uses — the CLI flag sugar that
+*writes* pins, ``run/config.py``'s save/restore snapshotting,
+``__main__``'s pre-import ``JAX_PLATFORMS`` honor — carry per-line
+suppressions with reasons.
+
+**QFX101 (pin-doc-table).** The rehosted ``check_pins`` contract: an
+exact ``"QFEDX_*"`` string literal in package code IS a pin
+reference, and every pin must have a row in the
+docs/OBSERVABILITY.md pin table — both directions (a stale row
+misdocuments the system as surely as a missing one).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from qfedx_tpu.analysis.engine import Finding, LintContext, Rule, register
+from qfedx_tpu.analysis.loader import Module, load_tree
+
+PINS_MODULE_SUFFIX = "utils/pins.py"
+
+_PIN_LITERAL = re.compile(r"QFEDX_[A-Z0-9_]+\Z")
+_TABLE_ROW = re.compile(r"^\|\s*`(QFEDX_[A-Z0-9_]+)`")
+
+PIN_DOC = "docs/OBSERVABILITY.md"
+
+
+# -- QFX002 --------------------------------------------------------------------
+
+
+def raw_env_uses(mod: Module) -> list[tuple[int, str]]:
+    """``[(lineno, spelling)]`` of ``os.environ`` attribute uses and
+    ``os.getenv`` calls, via this module's import aliases."""
+    os_aliases = {"os"}
+    getenv_aliases = set()
+    environ_aliases = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "os":
+                    os_aliases.add(a.asname or "os")
+        elif isinstance(node, ast.ImportFrom) and node.module == "os":
+            for a in node.names:
+                if a.name == "getenv":
+                    getenv_aliases.add(a.asname or "getenv")
+                elif a.name == "environ":
+                    environ_aliases.add(a.asname or "environ")
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("environ", "getenv") and isinstance(
+                node.value, ast.Name
+            ) and node.value.id in os_aliases:
+                out.append((node.lineno, f"os.{node.attr}"))
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id in getenv_aliases:
+                out.append((node.lineno, "os.getenv"))
+            elif node.id in environ_aliases:
+                out.append((node.lineno, "os.environ"))
+    return out
+
+
+def _run_raw_pin_read(ctx: LintContext) -> list[Finding]:
+    out: list[Finding] = []
+    for rel, mod in sorted(ctx.modules.items()):
+        if rel.endswith(PINS_MODULE_SUFFIX):
+            continue
+        for lineno, spelling in raw_env_uses(mod):
+            out.append(Finding(
+                "QFX002", rel, lineno,
+                f"raw {spelling} outside utils/pins.py — route the read "
+                "through a pins helper (bool_pin/str_pin/choice_pin/...) "
+                "so the grammar and the loud-typo contract hold",
+            ))
+    return out
+
+
+register(Rule(
+    "QFX002", "raw-pin-read",
+    "every env read funnels through utils/pins (one grammar, loud "
+    "typos, documented trace-time semantics)",
+    _run_raw_pin_read,
+))
+
+
+# -- QFX101 (rehosted check_pins) ----------------------------------------------
+
+
+def source_pins(package_root: str | Path | None = None) -> dict[str, list[str]]:
+    """``{pin_name: ["rel/path.py:lineno", ...]}`` for every exact
+    ``QFEDX_*`` string literal in package code. ``package_root``
+    defaults to the in-repo ``qfedx_tpu`` package (the historical
+    ``benchmarks/check_pins.py`` surface)."""
+    root = Path(package_root) if package_root else _default_package_root()
+    pins: dict[str, list[str]] = {}
+    for rel, mod in load_tree(root).items():
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and _PIN_LITERAL.fullmatch(node.value)
+            ):
+                pins.setdefault(node.value, []).append(
+                    f"{rel}:{node.lineno}"
+                )
+    return pins
+
+
+def documented_pins(doc_path: str | Path | None = None) -> set[str]:
+    """Pin names with a row in the OBSERVABILITY.md pin table."""
+    return set(documented_pin_rows(doc_path))
+
+
+def documented_pin_rows(
+    doc_path: str | Path | None = None,
+) -> dict[str, int]:
+    """``{pin_name: doc line number}`` — the line-carrying variant the
+    engine anchors stale-row findings on."""
+    path = Path(doc_path) if doc_path else _default_repo_root() / PIN_DOC
+    names: dict[str, int] = {}
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        m = _TABLE_ROW.match(line.strip())
+        if m:
+            names.setdefault(m.group(1), i)
+    return names
+
+
+def check(
+    package_root: str | Path | None = None,
+    doc_path: str | Path | None = None,
+) -> list[str]:
+    """Problem strings (empty = clean) — the historical check_pins
+    surface, kept verbatim for its tests and standalone runs."""
+    pins = source_pins(package_root)
+    documented = documented_pins(doc_path)
+    problems = [
+        f"pin {name} read at {', '.join(sites)} has no row in the "
+        "docs/OBSERVABILITY.md pin table"
+        for name, sites in sorted(pins.items())
+        if name not in documented
+    ]
+    problems += [
+        f"pin table row {name} matches no QFEDX_* literal in qfedx_tpu/ "
+        "(stale doc row?)"
+        for name in sorted(documented - set(pins))
+    ]
+    return problems
+
+
+def _default_repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+def _default_package_root() -> Path:
+    return _default_repo_root() / "qfedx_tpu"
+
+
+def _run_pin_table(ctx: LintContext) -> list[Finding]:
+    out: list[Finding] = []
+    doc = ctx.doc(PIN_DOC)
+    rows = documented_pin_rows(doc) if doc.exists() else {}
+    pins: dict[str, list[tuple[str, int]]] = {}
+    for rel, mod in sorted(ctx.modules.items()):
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and _PIN_LITERAL.fullmatch(node.value)
+            ):
+                pins.setdefault(node.value, []).append((rel, node.lineno))
+    for name, sites in sorted(pins.items()):
+        if name not in rows:
+            rel, lineno = sites[0]
+            out.append(Finding(
+                "QFX101", rel, lineno,
+                f"pin {name} has no row in the {PIN_DOC} pin table "
+                f"(also read at: "
+                f"{', '.join(f'{r}:{l}' for r, l in sites[1:]) or 'nowhere else'})",
+            ))
+    for name, doc_line in sorted(rows.items()):
+        if name not in pins:
+            out.append(Finding(
+                "QFX101", PIN_DOC, doc_line,
+                f"pin table row {name} matches no QFEDX_* literal in "
+                "package code (stale doc row?)",
+            ))
+    return out
+
+
+register(Rule(
+    "QFX101", "pin-doc-table",
+    "every QFEDX_* pin in source has a docs/OBSERVABILITY.md table row "
+    "and every row matches source (both directions)",
+    _run_pin_table,
+))

@@ -1,0 +1,71 @@
+"""QFX105 — no bare ``print()`` in library code (rehosted check_no_print).
+
+Telemetry goes through ``obs`` (spans/counters) and ``run/metrics``
+(JSONL artifacts); progress text goes through the primary-gated
+``say`` in ``run/cli.py``. A stray ``print`` in library code
+interleaves across multi-host pods and is invisible to every exporter
+— the reference's whole observability story was prints, which is
+exactly what this repo replaced. AST-based (string literals and
+docstrings mentioning print are fine); the allowlist names the two
+terminal-output entry points and nothing else.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from qfedx_tpu.analysis.engine import Finding, LintContext, Rule, register
+from qfedx_tpu.analysis.loader import Module, load_tree
+
+# Files whose job is terminal output: the argparse CLI (primary-gated
+# ``say``) and the walkthrough demo script. Package-relative, the
+# historical check_no_print surface.
+ALLOWED = {"run/cli.py", "run/demo.py"}
+
+
+def print_calls(mod: Module) -> list[int]:
+    return [
+        node.lineno
+        for node in ast.walk(mod.tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "print"
+    ]
+
+
+def find_prints(package_root: str | Path | None = None) -> list[str]:
+    """``["rel/path.py:lineno", ...]`` of bare print() calls under
+    ``package_root`` (default: the in-repo qfedx_tpu package),
+    excluding ALLOWED — the historical check_no_print surface."""
+    if package_root is None:
+        package_root = Path(__file__).resolve().parents[2] / "qfedx_tpu"
+    offenders: list[str] = []
+    for rel, mod in load_tree(Path(package_root)).items():
+        if rel in ALLOWED:
+            continue
+        offenders.extend(f"{rel}:{lineno}" for lineno in print_calls(mod))
+    return sorted(offenders)
+
+
+def _run(ctx: LintContext) -> list[Finding]:
+    out: list[Finding] = []
+    for rel, mod in sorted(ctx.modules.items()):
+        if any(rel.endswith(a) for a in ALLOWED):
+            continue
+        for lineno in print_calls(mod):
+            out.append(Finding(
+                "QFX105", rel, lineno,
+                "bare print() in library code — route telemetry through "
+                "obs spans/counters or run/metrics JSONL (prints "
+                "interleave across hosts and reach no exporter)",
+            ))
+    return out
+
+
+register(Rule(
+    "QFX105", "no-print",
+    "no bare print() outside run/cli.py + run/demo.py — telemetry "
+    "flows through obs/metrics where exporters can see it",
+    _run,
+))

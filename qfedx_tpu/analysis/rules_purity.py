@@ -1,0 +1,185 @@
+"""QFX001 — trace-purity: no host impurity reachable from traced code.
+
+A function traced by ``jax.jit``/``lax.scan``/``vmap``/``shard_map``
+runs ONCE, at trace time; whatever host values it computes are baked
+into the program as constants. Host time (``time.time``), host
+randomness (``random.*``, ``np.random.*``), file IO and raw
+``os.environ`` reads inside that code are therefore silent
+correctness bugs of the worst kind: the program runs, the constant is
+whatever the host happened to say during trace, and every replay —
+including the bit-exactness reruns the SA/survivor/staleness parity
+pins depend on — sees a value frozen from some other moment. The rule
+walks the call graph from every traced root and reports each impure
+call/access it can reach, with the witness path.
+
+Sanctioned seams (documented, deliberately exempt):
+
+- ``utils/pins.py`` — THE env funnel; trace-time pin reads are the
+  engine-routing design (docs/OBSERVABILITY.md "read at trace time")
+  and are loud on typos. Raw environ anywhere else still fires.
+
+Everything else intentional (e.g. ``obs/trace.py``'s span clock —
+spans inside jit time the TRACE, by design) carries a per-line
+``# qfedx: ignore[QFX001] reason``, so the exemption is visible at
+the site instead of buried in the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from qfedx_tpu.analysis.engine import Finding, LintContext, Rule, register
+from qfedx_tpu.analysis.loader import Module
+
+# Modules whose impure sites are the sanctioned design (see docstring).
+EXEMPT_MODULE_SUFFIXES = ("utils/pins.py",)
+
+# (module alias chain tail, attr) call patterns that are impure on the
+# host. Matched against dotted call names resolved per-module imports.
+_TIME_FNS = {"time", "time_ns", "perf_counter", "perf_counter_ns",
+             "monotonic", "monotonic_ns", "sleep"}
+_DATETIME_FNS = {"now", "utcnow", "today"}
+
+
+def _dotted_name(node: ast.AST) -> list[str]:
+    """``np.random.normal`` -> ["np", "random", "normal"]; [] if not a
+    plain dotted chain."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def _module_aliases(mod: Module) -> dict[str, str]:
+    """{local alias: real top module} for the impure stdlib surfaces."""
+    out: dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                top = a.name.split(".")[0]
+                if top in ("time", "random", "os", "datetime", "numpy"):
+                    out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            top = node.module.split(".")[0]
+            for a in node.names:
+                if top in ("time", "random", "os", "datetime", "numpy"):
+                    out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def impure_sites(mod: Module) -> list[tuple[int, str]]:
+    """``[(lineno, description)]`` of impure host calls/accesses in
+    ``mod``, resolved through its import aliases."""
+    aliases = _module_aliases(mod)
+
+    def real(chain: list[str]) -> list[str]:
+        if not chain:
+            return chain
+        mapped = aliases.get(chain[0])
+        if mapped is None:
+            return chain
+        return mapped.split(".") + chain[1:]
+
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            chain = real(_dotted_name(node.func))
+            if not chain:
+                continue
+            if chain == ["open"]:
+                out.append((node.lineno, "builtin open()"))
+            elif chain[0] == "time" and chain[-1] in _TIME_FNS and (
+                len(chain) == 2
+            ):
+                out.append((node.lineno, f"time.{chain[-1]}()"))
+            elif chain[0] == "datetime" and chain[-1] in _DATETIME_FNS:
+                out.append((node.lineno, f"datetime.{chain[-1]}()"))
+            elif chain[0] == "random" and len(chain) == 2:
+                out.append((node.lineno, f"random.{chain[1]}()"))
+            elif chain[0] == "numpy" and len(chain) >= 3 and (
+                chain[1] == "random"
+            ):
+                out.append(
+                    (node.lineno, f"np.random.{'.'.join(chain[2:])}()")
+                )
+            elif chain[0] == "os" and chain[-1] == "getenv":
+                out.append((node.lineno, "os.getenv()"))
+        elif isinstance(node, ast.Attribute) and node.attr == "environ":
+            chain = real(_dotted_name(node))
+            if chain[:1] == ["os"] or chain[:2] == ["os", "environ"]:
+                out.append((node.lineno, "os.environ"))
+    return out
+
+
+def _run(ctx: LintContext) -> list[Finding]:
+    graph = ctx.callgraph
+    reach = graph.reachable_from_traced()
+    # Group reachable functions by module, so each module's AST is
+    # scanned once and sites are attributed to their enclosing function.
+    out: list[Finding] = []
+    seen: set[tuple[str, int]] = set()
+    # One full-AST scan per MODULE, not per reachable function — many
+    # functions share a module, and the scan is the expensive half.
+    sites_by_rel: dict[str, list[tuple[int, str]]] = {}
+    for key, path in sorted(reach.items()):
+        info = graph.functions[key]
+        rel = info.module.rel
+        if rel.endswith(EXEMPT_MODULE_SUFFIXES):
+            continue
+        sites = sites_by_rel.get(rel)
+        if sites is None:
+            sites = sites_by_rel[rel] = impure_sites(info.module)
+        fnode = info.node
+        span = (fnode.lineno, getattr(fnode, "end_lineno", fnode.lineno))
+        for lineno, what in sites:
+            if not (span[0] <= lineno <= span[1]):
+                continue
+            # Attribute the site to the INNERMOST reachable function
+            # containing it — an outer function's span also covers its
+            # nested defs, which would double-report.
+            inner = _innermost_containing(graph, info.module, lineno, reach)
+            if inner != key:
+                continue
+            if (rel, lineno) in seen:
+                continue
+            seen.add((rel, lineno))
+            root = path[0]
+            why = graph.traced_roots.get(root, "?")
+            chain = " -> ".join(
+                graph.functions[k].qualname for k in path
+            )
+            out.append(Finding(
+                "QFX001", rel, lineno,
+                f"{what} reachable from traced function (traced at "
+                f"{why}; path: {chain}) — host state must not leak "
+                "into a traced program",
+            ))
+    return out
+
+
+def _innermost_containing(graph, module, lineno: int, reach) -> str | None:
+    best, best_span = None, None
+    for key in reach:
+        info = graph.functions[key]
+        if info.module is not module:
+            continue
+        n = info.node
+        lo, hi = n.lineno, getattr(n, "end_lineno", n.lineno)
+        if lo <= lineno <= hi:
+            if best_span is None or (hi - lo) < best_span:
+                best, best_span = key, hi - lo
+    return best
+
+
+register(Rule(
+    "QFX001", "trace-purity",
+    "no host time/randomness/IO/raw-environ reachable from jit/scan/"
+    "vmap/shard_map-traced code (bit-exact replay guarantee)",
+    _run,
+))

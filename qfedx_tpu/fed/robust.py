@@ -39,12 +39,10 @@ straggler can neither dominate a later round nor evade the trim.
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 
-from qfedx_tpu.utils import trees
+from qfedx_tpu.utils import pins, trees
 
 AGGREGATORS = ("mean", "clip_mean", "trimmed_mean", "median")
 ROBUST_AGGREGATORS = ("trimmed_mean", "median")
@@ -55,15 +53,8 @@ def resolve_aggregator(cfg) -> str:
     QFEDX_FOLD_CLIENTS) overrides ``cfg.aggregator``; a typo raises
     loudly — the wrong-defense-measured error class is the same one the
     pin grammar exists to prevent."""
-    env = os.environ.get("QFEDX_AGG")
-    if env is None:
-        return cfg.aggregator
-    low = env.lower()
-    if low not in AGGREGATORS:
-        raise ValueError(
-            f"QFEDX_AGG={env!r}: expected one of {AGGREGATORS}"
-        )
-    return low
+    env = pins.choice_pin("QFEDX_AGG", AGGREGATORS, None)
+    return cfg.aggregator if env is None else env
 
 
 def staleness_discount(mode: str, alpha: float, ages):

@@ -102,7 +102,7 @@ def profile_dir(default: str | None = None) -> str | None:
     """Resolve QFEDX_PROFILE to a capture directory, or None when the
     pin is off/unset (see module docstring; loud on typos like every
     QFEDX_* pin)."""
-    env = os.environ.get("QFEDX_PROFILE")
+    env = pins.str_pin("QFEDX_PROFILE")
     if env is None:
         return None
     as_bool = pins.parse_onoff(env)

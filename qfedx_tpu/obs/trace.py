@@ -340,7 +340,7 @@ class span:
                 import jax
 
                 self._annot = jax.profiler.TraceAnnotation(self._name)
-                self._annot.__enter__()
+                self._annot.__enter__()  # qfedx: ignore[QFX003] the paired exit is in span.__exit__ — the annotation brackets this span's own enter/exit by construction
             except Exception:  # noqa: BLE001 — annotation is an optional bridge
                 self._annot = None
         stack.append(sp)

@@ -38,12 +38,11 @@ frontier this path serves.
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 
 from qfedx_tpu.ops.cpx import CArray
+from qfedx_tpu.utils import pins
 from qfedx_tpu.ops.statevector import (
     _LANE_BITS,
     _LANES,
@@ -64,15 +63,10 @@ def batched_enabled(n_qubits: int) -> bool:
     like QFEDX_DTYPE, set it before first trace."""
     if n_qubits < _SLAB_MIN:
         return False
-    env = os.environ.get("QFEDX_BATCHED")
-    if env is not None:
-        if env not in ("0", "1"):
-            raise ValueError(f"QFEDX_BATCHED={env!r}: expected '0' or '1'")
-        return env == "1"
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:  # noqa: BLE001 — no backend yet: conservative
-        return False
+    # bool_pin speaks the family grammar (0/off/1/on, loud on typos) —
+    # the historical '0'/'1'-only parser here was one of the per-pin
+    # drifts the shared grammar exists to end.
+    return pins.bool_pin("QFEDX_BATCHED", pins.tpu_backend_default)
 
 
 def _cmap(c: CArray, f) -> CArray:

@@ -16,11 +16,12 @@ time at zero runtime cost.
 
 from __future__ import annotations
 
-import os
 from typing import NamedTuple
 
 import jax.numpy as jnp
 import numpy as np
+
+from qfedx_tpu.utils import pins
 
 RDTYPE = jnp.float32
 
@@ -43,7 +44,7 @@ def state_dtype():
     time; f32 is the default."""
     return (
         jnp.bfloat16
-        if os.environ.get("QFEDX_DTYPE", "float32") in ("bf16", "bfloat16")
+        if pins.str_pin("QFEDX_DTYPE", "float32") in ("bf16", "bfloat16")
         else jnp.float32
     )
 

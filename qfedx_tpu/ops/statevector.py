@@ -32,12 +32,11 @@ dense statevector at 20 qubits — sharding is how we reach that and beyond).
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 
 from qfedx_tpu.ops.cpx import CArray, cabs2, state_dtype, vdot
+from qfedx_tpu.utils import pins
 
 
 def zero_state(n_qubits: int) -> CArray:
@@ -111,15 +110,14 @@ def _gate_form() -> str:
     BEFORE the first trace of a function — flipping it afterwards
     silently keeps running the already-traced formulation (ADVICE r04
     item 1; the wrong-path-measured error class)."""
-    env = os.environ.get("QFEDX_GATE_FORM")
-    if env:
-        if env not in ("flip", "dot"):
-            # A typo here would silently measure/run the OTHER
-            # formulation — the wrong-path-measured error class.
-            raise ValueError(
-                f"QFEDX_GATE_FORM={env!r}: expected 'flip' or 'dot'"
-            )
-        return env
+    # choice_pin keeps the loud-typo contract: a misspelling would
+    # silently measure/run the OTHER formulation (wrong-path-measured).
+    return pins.choice_pin(
+        "QFEDX_GATE_FORM", ("flip", "dot"), _backend_gate_form
+    )
+
+
+def _backend_gate_form() -> str:
     try:
         return "flip" if jax.default_backend() == "tpu" else "dot"
     except Exception:  # noqa: BLE001 — no backend yet: safe choice
@@ -293,13 +291,12 @@ def _lane_strategy() -> str:
     (the slab parity/bf16 tests pin "matmul" to cover the TPU path on
     CPU). Read at TRACE time, not part of any jit cache key — set BEFORE
     first trace (see _gate_form)."""
-    env = os.environ.get("QFEDX_SLAB_LANES")
-    if env:
-        if env not in ("matmul", "flip"):
-            raise ValueError(
-                f"QFEDX_SLAB_LANES={env!r}: expected 'matmul' or 'flip'"
-            )
-        return env
+    return pins.choice_pin(
+        "QFEDX_SLAB_LANES", ("matmul", "flip"), _backend_lane_strategy
+    )
+
+
+def _backend_lane_strategy() -> str:
     try:
         return "matmul" if jax.default_backend() == "tpu" else "flip"
     except Exception:  # noqa: BLE001 — no backend yet: cheap choice

@@ -213,6 +213,24 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["quick", "roadmap", "baseline"])
     s.add_argument("--seeds", type=int, default=3)
     s.add_argument("--run-root", default="runs")
+
+    lnt = sub.add_parser(
+        "lint",
+        help="AST static analysis: trace-purity, pin discipline, span/"
+             "lock/donation hygiene, doc-taxonomy contracts "
+             "(docs/ANALYSIS.md); exit 1 on non-baselined findings",
+    )
+    lnt.add_argument("--json", action="store_true", dest="as_json",
+                     help="machine-readable report on stdout (schema v1)")
+    lnt.add_argument("--rules", default=None,
+                     help="comma-separated rule IDs to run (default: all)")
+    lnt.add_argument("--baseline", default=None,
+                     help="override the [tool.qfedx.lint] baseline path")
+    lnt.add_argument("--update-baseline", action="store_true",
+                     help="rewrite the baseline from current findings "
+                          "(grandfather them) instead of failing")
+    lnt.add_argument("--show-baselined", action="store_true",
+                     help="also print baselined findings in text mode")
     return p
 
 
@@ -298,11 +316,10 @@ def run_train(
     profile: bool = False,
     trace: bool = False,
 ) -> dict:
-    import os
-
     from qfedx_tpu import obs
     from qfedx_tpu.run.metrics import ExperimentRun
     from qfedx_tpu.run.trainer import train_federated
+    from qfedx_tpu.utils import pins
     from qfedx_tpu.utils.host import is_primary
 
     if trace:
@@ -310,7 +327,7 @@ def run_train(
         # routing), so setting it here covers the whole run including
         # build_data below. reset() drops any import-time spans so the
         # trace.json window is exactly this run.
-        os.environ["QFEDX_TRACE"] = "1"
+        pins.set_pin("QFEDX_TRACE", "1")
         obs.reset()
 
     # Multi-host: progress lines from every process interleave on shared
@@ -355,13 +372,15 @@ def run_train(
         if profile and prof_dir is None:
             prof_dir = str(run.dir / "profile")
         xla_bridge_set = False
-        if prof_dir is not None and trace and "QFEDX_TRACE_XLA" not in os.environ:
+        if prof_dir is not None and trace and not pins.pin_is_set(
+            "QFEDX_TRACE_XLA"
+        ):
             # Mirror spans into the capture so the parser can attribute
             # device time per phase (span correlation); costs one C++
             # annotation per span, only worth paying while profiling —
             # restored in the finally so it cannot leak past this run
             # in a long-lived process.
-            os.environ["QFEDX_TRACE_XLA"] = "1"
+            pins.set_pin("QFEDX_TRACE_XLA", "1")
             xla_bridge_set = True
         profile_ctx = (
             obs.profile.capture(prof_dir) if prof_dir is not None
@@ -392,7 +411,7 @@ def run_train(
                 )
         finally:
             if xla_bridge_set:
-                os.environ.pop("QFEDX_TRACE_XLA", None)
+                pins.clear_pin("QFEDX_TRACE_XLA")
             if prof_dir is not None and is_primary():
                 # Parse the capture even on the crash path — the killed
                 # run is the one that most needs its device timeline.
@@ -466,7 +485,6 @@ def run_serve(args) -> dict:
     backpressures the reader instead of ballooning futures.
     """
     import contextlib
-    import os
     import sys
 
     from qfedx_tpu import obs
@@ -476,10 +494,11 @@ def run_serve(args) -> dict:
         ServeConfig,
         engine_from_run_dir,
     )
+    from qfedx_tpu.utils import pins
     from qfedx_tpu.utils.host import is_primary
 
     if args.trace:
-        os.environ["QFEDX_TRACE"] = "1"
+        pins.set_pin("QFEDX_TRACE", "1")
         obs.reset()
     say = print if is_primary() else (lambda *a, **k: None)
 
@@ -749,12 +768,52 @@ def run_inspect(run_dir) -> dict:
     return out
 
 
+def run_lint_cmd(args) -> int:
+    """``qfedx lint``: run the analysis engine, print text or JSON,
+    exit non-zero on any non-baselined finding (the tier-1 contract —
+    tests/test_lint.py gates the same engine)."""
+    from qfedx_tpu import analysis
+    from qfedx_tpu.analysis import engine as lint_engine
+    from qfedx_tpu.utils.host import is_primary
+
+    say = print if is_primary() else (lambda *a, **k: None)
+    cfg = analysis.load_config()
+    if args.baseline:
+        cfg.baseline = args.baseline
+    rules = (
+        tuple(r.strip() for r in args.rules.split(",") if r.strip())
+        if args.rules else None
+    )
+    result = analysis.run_lint(config=cfg, rules=rules)
+    if args.update_baseline:
+        ctx = lint_engine.LintContext(cfg)
+        n = lint_engine.write_baseline(
+            cfg.baseline_path, ctx,
+            result.findings + result.baselined,
+            rules_run=result.rules_run,
+        )
+        say(f"[qfedx_tpu] baseline rewritten: {cfg.baseline_path} "
+            f"({n} entries)")
+        return 0
+    if args.as_json:
+        say(analysis.render_json(result))
+    else:
+        say(analysis.render_text(
+            result, verbose_baselined=args.show_baselined
+        ))
+    return 0 if result.ok else 1
+
+
 def main(argv=None):
     # NOTE: JAX_PLATFORMS is honored in qfedx_tpu/__main__.py, BEFORE any
     # qfedx_tpu import can initialize the backend (the gate library builds
     # jnp constants at import time). Nothing platform-related can be done
     # this late.
     args = build_parser().parse_args(argv)
+    if args.cmd == "lint":
+        # No compile cache, no backend, no heavy imports: lint is a
+        # pure AST pass, seconds not minutes (docs/ANALYSIS.md).
+        raise SystemExit(run_lint_cmd(args))
     # Persistent XLA compilation cache (QFEDX_COMPILE_CACHE; default on —
     # shared definition with bench.py in qfedx_tpu.utils.cache). Enabled
     # before dispatching ANY subcommand: train pays one cold n=18 slab

@@ -578,9 +578,7 @@ def train_federated(
             ckpt_due = checkpointer is not None and (
                 is_last or (rnd + chunk) % checkpointer.every == 0
             )
-            params_ref = (
-                params if (is_last or will_host_eval or ckpt_due) else None
-            )
+            params_ref = params if (is_last or will_host_eval or ckpt_due) else None  # qfedx: ignore[QFX005] alias is safe by construction: consumed by this chunk's drain before the next donating dispatch at depth 0, and replaced by the jnp.copy snapshot below otherwise
             if (
                 params_ref is not None
                 and donating

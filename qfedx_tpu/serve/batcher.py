@@ -306,11 +306,15 @@ class MicroBatcher:
             if taken is None:
                 return
             reqs, kind = taken
-            if kind == "deadline":
-                self.stats["deadline_flushes"] += 1
-            elif kind == "full":
-                self.stats["full_flushes"] += 1
             with self._cond:
+                # Stats bumps live under the ONE lock everywhere (the
+                # QFX004 lock-discipline contract): _health() hands out
+                # dict(self.stats) under it, and dict iteration racing
+                # a store is a RuntimeError, not just a lost count.
+                if kind == "deadline":
+                    self.stats["deadline_flushes"] += 1
+                elif kind == "full":
+                    self.stats["full_flushes"] += 1
                 self._batch_seq += 1
                 batch_seq = self._batch_seq
                 drain_mode = self._closed and not self._drain
@@ -349,5 +353,6 @@ class MicroBatcher:
                 obs.histogram(
                     "serve.latency_ms", (fut.done_t - fut.submit_t) * 1e3
                 )
-            self.stats["served"] += len(reqs)
-            self.stats["batches"] += 1
+            with self._cond:  # see the stats-under-lock note above
+                self.stats["served"] += len(reqs)
+                self.stats["batches"] += 1

@@ -214,7 +214,7 @@ class ServeEngine:
         return {
             "buckets": per_bucket,
             "num_classes": int(out.shape[-1]),
-            "route": {p: os.environ.get(p, "") for p in _ROUTING_PINS},
+            "route": {p: pins.str_pin(p, "") for p in _ROUTING_PINS},
             "route_resolved": {
                 "dtype": np.dtype(state_dtype()).name,
                 "fuse": fuse.fuse_enabled(),

@@ -40,11 +40,12 @@ evaluator, from bench — is a cache hit on the same executable.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Callable
 
 import jax
+
+from qfedx_tpu.utils import pins
 
 # Pins consulted while TRACING an engine program (build-time routing).
 # Per-call pins (QFEDX_TRACE, QFEDX_FAULTS) do not shape the program and
@@ -67,7 +68,7 @@ _LOCK = threading.Lock()
 
 
 def _routing_key() -> tuple:
-    return tuple(os.environ.get(p, "") for p in _ROUTING_PINS)
+    return tuple(pins.str_pin(p, "") for p in _ROUTING_PINS)
 
 
 def persistent_forward(fwd: Callable) -> Callable:

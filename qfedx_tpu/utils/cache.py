@@ -36,7 +36,7 @@ _DEFAULT_DIR = os.path.join("~", ".cache", "qfedx_tpu", "xla")
 def compile_cache_dir(default: str | None = None) -> str | None:
     """Resolve the cache directory from QFEDX_COMPILE_CACHE (see module
     docstring); ``None`` means the cache is pinned off."""
-    env = os.environ.get("QFEDX_COMPILE_CACHE")
+    env = pins.str_pin("QFEDX_COMPILE_CACHE")
     if env is None:
         return os.path.expanduser(default or _DEFAULT_DIR)
     as_bool = pins.parse_onoff(env)

@@ -101,6 +101,8 @@ from pathlib import Path
 
 import numpy as np
 
+from qfedx_tpu.utils import pins
+
 SITES = (
     "client.compute",
     "registry.fetch",
@@ -632,7 +634,7 @@ def active_plan() -> FaultPlan | None:
     path is re-read on every resolve — an operator editing the plan
     behind an unchanged path must not be served a stale parse (the
     per-call contract), and the files are tiny."""
-    value = os.environ.get("QFEDX_FAULTS", "")
+    value = pins.str_pin("QFEDX_FAULTS", "")
     if value.lower() in ("", "0", "off"):
         return None
     if value.lstrip().startswith("{"):

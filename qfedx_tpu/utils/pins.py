@@ -128,6 +128,61 @@ def port_pin(name: str, default: int = 0) -> int:
     return int(env)
 
 
+def str_pin(name: str, default: str | None = None) -> str | None:
+    """The raw string value of pin ``name`` (``default`` when unset).
+
+    The pass-through helper for pins whose grammar lives at the caller
+    (QFEDX_FAULTS' JSON/path values, QFEDX_PROFILE / QFEDX_COMPILE_CACHE's
+    on/off/path hybrid, the serve route snapshot that records every
+    routing pin verbatim). No validation by design — it exists so raw
+    ``os.environ`` reads still funnel through ONE module (the QFX002
+    lint contract, docs/ANALYSIS.md) and the read site stays greppable."""
+    return os.environ.get(name, default)
+
+
+def choice_pin(
+    name: str,
+    choices: tuple[str, ...],
+    default: str | None | Callable[[], str | None],
+) -> str | None:
+    """Resolve an enumerated-string pin (QFEDX_GATE_FORM's flip/dot,
+    QFEDX_SLAB_LANES' matmul/flip, QFEDX_AGG's aggregator rules) with
+    the family's loud grammar: unset or empty → ``default`` (callable
+    for lazy backend-dependent defaults, same convention as bool_pin),
+    a case-insensitive match of one of ``choices`` → that choice,
+    anything else raises — a typo must never silently route the other
+    engine (the wrong-path-measured error class, module docstring)."""
+    env = os.environ.get(name)
+    if not env:  # unset OR empty: the historical "if env:" gate
+        return default() if callable(default) else default
+    low = env.lower()
+    if low not in choices:
+        raise ValueError(
+            f"{name}={env!r}: expected one of {choices}"
+        )
+    return low
+
+
+def set_pin(name: str, value: str) -> None:
+    """Write a pin for this process (CLI flag sugar: ``--trace`` sets
+    QFEDX_TRACE=1). Writes funnel through here for the same reason
+    reads do — one greppable seam instead of scattered ``os.environ``
+    mutations (QFX002)."""
+    os.environ[name] = value
+
+
+def clear_pin(name: str) -> None:
+    """Unset a pin (no-op when absent) — ``set_pin``'s inverse."""
+    os.environ.pop(name, None)
+
+
+def pin_is_set(name: str) -> bool:
+    """Is the pin present in the environment at all? (Distinct from its
+    parsed value: callers that only overlay a default must not clobber
+    an operator's explicit setting.)"""
+    return name in os.environ
+
+
 def depth_pin(name: str, default: int, on_value: int = 1) -> int:
     """Resolve an integer-depth pin with the on/off grammar as a prefix:
     ``0``/``off`` → 0, ``1``/``on`` → ``on_value``, a bare integer → that

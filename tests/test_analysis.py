@@ -1,0 +1,504 @@
+"""Unit fixtures for the `qfedx lint` engine (qfedx_tpu/analysis).
+
+Each new rule (QFX001–QFX005) gets one minimal POSITIVE snippet (the
+rule must fire) and one NEGATIVE (it must stay quiet) — the
+"demonstrably fires on a fixture" half of the ISSUE 15 acceptance.
+Engine semantics (suppressions, baseline multiset + staleness, JSON
+schema round-trip) and call-graph reachability (direct, aliased
+import, method) are pinned here too. Everything runs on tmp_path
+fixture packages through the same run_lint entry the CLI and tier-1
+use — no internal shortcuts that could drift from the real path.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from qfedx_tpu.analysis import (  # noqa: E402
+    LintConfig,
+    render_json,
+    run_lint,
+)
+from qfedx_tpu.analysis.callgraph import build_callgraph  # noqa: E402
+from qfedx_tpu.analysis.loader import load_tree  # noqa: E402
+
+
+def make_repo(tmp_path, files: dict[str, str]) -> LintConfig:
+    """A throwaway repo with a ``pkg/`` package; returns its config."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, text in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return LintConfig(
+        root=tmp_path, packages=("pkg",),
+        baseline=str(tmp_path / "baseline.json"),
+    )
+
+
+def findings_for(tmp_path, rule: str, files: dict[str, str]):
+    cfg = make_repo(tmp_path, files)
+    result = run_lint(config=cfg, rules=(rule,))
+    return result.findings
+
+
+# --- QFX001 trace-purity ------------------------------------------------------
+
+
+def test_qfx001_fires_on_impure_reachable_from_jit(tmp_path):
+    found = findings_for(tmp_path, "QFX001", {"mod.py": """
+        import time
+        import jax
+
+        def helper():
+            return time.time()
+
+        def step(x):
+            return helper() + x
+
+        fast = jax.jit(step)
+    """})
+    assert len(found) == 1
+    assert "time.time()" in found[0].message
+    assert "helper" in found[0].message  # witness path names the chain
+
+
+def test_qfx001_quiet_when_impurity_unreachable(tmp_path):
+    found = findings_for(tmp_path, "QFX001", {"mod.py": """
+        import time
+        import jax
+
+        def host_only():
+            return time.time()
+
+        def step(x):
+            return x * 2
+
+        fast = jax.jit(step)
+    """})
+    assert found == []
+
+
+def test_qfx001_scan_body_and_np_random(tmp_path):
+    found = findings_for(tmp_path, "QFX001", {"mod.py": """
+        import numpy as np
+        from jax import lax
+
+        def body(carry, x):
+            return carry + np.random.normal(), x
+
+        def run(xs):
+            return lax.scan(body, 0.0, xs)
+    """})
+    assert len(found) == 1
+    assert "np.random.normal" in found[0].message
+
+
+# --- QFX002 raw-pin-read ------------------------------------------------------
+
+
+def test_qfx002_fires_on_raw_environ_and_getenv(tmp_path):
+    found = findings_for(tmp_path, "QFX002", {"mod.py": """
+        import os
+        a = os.environ.get("QFEDX_X")
+        b = os.getenv("QFEDX_Y")
+    """})
+    assert len(found) == 2
+
+
+def test_qfx002_quiet_in_pins_module_and_helper_callers(tmp_path):
+    found = findings_for(tmp_path, "QFX002", {
+        "utils/pins.py": """
+            import os
+            def bool_pin(name, default):
+                return os.environ.get(name, default)
+        """,
+        "mod.py": """
+            from pkg.utils import pins
+            val = pins.bool_pin("QFEDX_X", False)
+        """,
+    })
+    assert found == []
+
+
+# --- QFX003 span-leak ---------------------------------------------------------
+
+
+def test_qfx003_fires_on_unclosed_span(tmp_path):
+    found = findings_for(tmp_path, "QFX003", {"mod.py": """
+        from pkg import obs
+
+        def f():
+            sp = obs.span("leaky.phase")
+            sp.__enter__()
+            do_work()
+    """})
+    # both the non-with factory call and the unprotected manual enter
+    assert len(found) == 2
+
+
+def test_qfx003_quiet_on_with_and_assigned_with(tmp_path):
+    found = findings_for(tmp_path, "QFX003", {"mod.py": """
+        from pkg import obs
+
+        def f():
+            with obs.span("clean.phase"):
+                pass
+            ctx = obs.span("later.phase")
+            with ctx:
+                pass
+    """})
+    assert found == []
+
+
+# --- QFX004 lock-discipline ---------------------------------------------------
+
+
+_LOCK_CLASS = """
+    import threading
+
+    class Registry:
+        def __init__(self):
+            self.counters = {}
+            self._lock = threading.Lock()
+
+        def good(self, name):
+            with self._lock:
+                self.counters[name] = self.counters.get(name, 0) + 1
+
+        def _bump_locked(self, name):
+            self.counters[name] = 1  # caller holds the lock (convention)
+"""
+
+
+def test_qfx004_fires_on_unlocked_mutation(tmp_path):
+    found = findings_for(tmp_path, "QFX004", {"mod.py": """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self.counters = {}
+                self._lock = threading.Lock()
+
+            def bad(self, name):
+                self.counters[name] = 0
+    """})
+    assert len(found) == 1
+    assert "self.counters" in found[0].message
+
+
+def test_qfx004_quiet_under_lock_and_locked_suffix(tmp_path):
+    found = findings_for(tmp_path, "QFX004", {"mod.py": _LOCK_CLASS})
+    assert found == []
+
+
+# --- QFX005 donation-after-use ------------------------------------------------
+
+
+def test_qfx005_fires_on_read_after_donating_dispatch(tmp_path):
+    found = findings_for(tmp_path, "QFX005", {"mod.py": """
+        import jax
+
+        def train(step, theta, xs):
+            fast = jax.jit(step, donate_argnums=(0,))
+            out = fast(theta, xs)
+            return theta  # donated buffer read back
+    """})
+    assert len(found) == 1
+    assert "'theta'" in found[0].message
+
+
+def test_qfx005_quiet_on_chaining_rebind(tmp_path):
+    found = findings_for(tmp_path, "QFX005", {"mod.py": """
+        import jax
+
+        def train(step, theta, xs):
+            fast = jax.jit(step, donate_argnums=(0,))
+            for x in xs:
+                theta, stats = fast(theta, x)
+            return theta
+    """})
+    assert found == []
+
+
+def test_qfx005_fires_on_loop_alias(tmp_path):
+    found = findings_for(tmp_path, "QFX005", {"mod.py": """
+        import jax
+
+        def train(step, theta, xs):
+            fast = jax.jit(step, donate_argnums=(0,))
+            refs = []
+            for x in xs:
+                theta, stats = fast(theta, x)
+                ref = theta
+                refs.append(ref)
+            return refs
+    """})
+    assert len(found) == 1
+    assert "alias 'ref'" in found[0].message
+
+
+# --- suppression semantics ----------------------------------------------------
+
+
+def test_suppression_with_reason_silences_and_counts(tmp_path):
+    cfg = make_repo(tmp_path, {"mod.py": """
+        import os
+        a = os.environ.get("QFEDX_X")  # qfedx: ignore[QFX002] fixture exemption
+    """})
+    result = run_lint(config=cfg, rules=("QFX000", "QFX002"))
+    assert result.findings == []
+    assert result.suppressed == 1
+
+
+def test_reasonless_suppression_is_a_finding_and_cannot_self_suppress(
+    tmp_path,
+):
+    cfg = make_repo(tmp_path, {"mod.py": """
+        import os
+        a = os.environ.get("QFEDX_X")  # qfedx: ignore[QFX002,QFX000]
+    """})
+    result = run_lint(config=cfg, rules=("QFX000", "QFX002"))
+    assert [f.rule for f in result.findings] == ["QFX000"]
+    assert result.suppressed == 1  # the QFX002 half still suppressed
+
+
+def test_suppression_grammar_in_strings_is_inert(tmp_path):
+    # The grammar inside a docstring or string literal is documentation,
+    # not an exemption: it must neither suppress a finding on its line
+    # nor trip QFX000 (reasonless) — only real COMMENT tokens count.
+    cfg = make_repo(tmp_path, {"mod.py": '''
+        """Example: x()  # qfedx: ignore[QFX002]"""
+        import os
+        s = 'os.environ  # qfedx: ignore[QFX002]'; a = os.environ.get("QFEDX_X")
+    '''})
+    result = run_lint(config=cfg, rules=("QFX000", "QFX002"))
+    assert [f.rule for f in result.findings] == ["QFX002"]
+    assert result.suppressed == 0
+
+
+def test_suppression_of_other_rule_does_not_silence(tmp_path):
+    cfg = make_repo(tmp_path, {"mod.py": """
+        import os
+        a = os.environ.get("QFEDX_X")  # qfedx: ignore[QFX005] wrong rule
+    """})
+    result = run_lint(config=cfg, rules=("QFX002",))
+    assert [f.rule for f in result.findings] == ["QFX002"]
+
+
+# --- baseline semantics -------------------------------------------------------
+
+
+def _baseline(tmp_path, entries):
+    (tmp_path / "baseline.json").write_text(
+        json.dumps({"version": 1, "entries": entries})
+    )
+
+
+def test_baseline_hides_matching_finding_by_line_text(tmp_path):
+    cfg = make_repo(tmp_path, {"mod.py": """
+        import os
+        a = os.environ.get("QFEDX_X")
+    """})
+    _baseline(tmp_path, [{
+        "rule": "QFX002", "path": "pkg/mod.py",
+        "text": 'a = os.environ.get("QFEDX_X")', "reason": "fixture",
+    }])
+    result = run_lint(config=cfg, rules=("QFX002",))
+    assert result.findings == []
+    assert len(result.baselined) == 1
+    assert result.ok
+
+
+def test_baseline_is_multiset_and_stale_entries_fail(tmp_path):
+    cfg = make_repo(tmp_path, {"mod.py": """
+        import os
+        a = os.environ.get("QFEDX_X")
+    """})
+    _baseline(tmp_path, [
+        {"rule": "QFX002", "path": "pkg/mod.py",
+         "text": 'a = os.environ.get("QFEDX_X")'},
+        {"rule": "QFX002", "path": "pkg/gone.py",
+         "text": "vanished = os.environ"},
+    ])
+    result = run_lint(config=cfg, rules=("QFX002",))
+    assert result.findings == []
+    assert len(result.baselined) == 1
+    assert len(result.stale_baseline) == 1  # the gone.py entry
+    assert not result.ok  # stale entries fail the run
+
+
+def test_baseline_entries_for_unselected_rules_are_ignored(tmp_path):
+    cfg = make_repo(tmp_path, {"mod.py": "x = 1\n"})
+    _baseline(tmp_path, [{
+        "rule": "QFX002", "path": "pkg/mod.py", "text": "whatever",
+    }])
+    result = run_lint(config=cfg, rules=("QFX005",))
+    assert result.ok  # a subset run can't judge other rules' entries
+
+
+def test_update_baseline_subset_run_preserves_other_rules(tmp_path):
+    # A `--rules` subset rewrite must not drop entries it never judged:
+    # run_lint ignores other rules' entries for matching AND staleness,
+    # so write_baseline(rules_run=...) preserves them verbatim.
+    from qfedx_tpu.analysis.engine import (
+        LintContext,
+        load_baseline,
+        write_baseline,
+    )
+
+    cfg = make_repo(tmp_path, {"mod.py": """
+        import os
+        a = os.environ.get("QFEDX_X")
+    """})
+    _baseline(tmp_path, [{
+        "rule": "QFX005", "path": "pkg/other.py",
+        "text": "return theta", "reason": "kept: not judged by QFX002",
+    }])
+    result = run_lint(config=cfg, rules=("QFX002",))
+    n = write_baseline(
+        cfg.baseline_path, LintContext(cfg),
+        result.findings + result.baselined,
+        rules_run=result.rules_run,
+    )
+    entries = load_baseline(cfg.baseline_path)
+    assert n == len(entries) == 2
+    assert {e["rule"] for e in entries} == {"QFX002", "QFX005"}
+    # and the rewritten file round-trips clean for the subset
+    assert run_lint(config=cfg, rules=("QFX002",)).ok
+
+
+def test_loader_parse_cache_shared_across_rel_keys(tmp_path):
+    # One parse per file regardless of how callers key it: the engine
+    # (repo-relative rels) and the historical check_* surfaces
+    # (package-relative rels) must share tree objects, and a second
+    # engine run must not re-parse (the sub-second CLI contract).
+    cfg = make_repo(tmp_path, {"mod.py": "x = 1\n"})
+    pkg_rel = load_tree(tmp_path / "pkg")["mod.py"]
+    repo_rel = load_tree(tmp_path / "pkg", rel_prefix="pkg")["pkg/mod.py"]
+    assert pkg_rel.tree is repo_rel.tree
+    assert pkg_rel.rel == "mod.py" and repo_rel.rel == "pkg/mod.py"
+    assert repo_rel.name == "pkg.mod"
+    again = load_tree(tmp_path / "pkg", rel_prefix="pkg")["pkg/mod.py"]
+    assert again.tree is repo_rel.tree
+
+
+# --- JSON schema round-trip ---------------------------------------------------
+
+
+def test_json_report_round_trip(tmp_path):
+    cfg = make_repo(tmp_path, {"mod.py": """
+        import os
+        a = os.environ.get("QFEDX_X")
+    """})
+    result = run_lint(config=cfg, rules=("QFX002",))
+    data = json.loads(render_json(result))
+    assert data["version"] == 1
+    assert data["ok"] is False
+    assert data["counts_by_rule"] == {"QFX002": 1}
+    assert data["summary"] == {
+        "new": 1, "baselined": 0, "suppressed": 0, "stale_baseline": 0,
+    }
+    (finding,) = data["findings"]
+    assert set(finding) == {"rule", "path", "line", "message", "baselined"}
+    assert finding["path"] == "pkg/mod.py"
+    assert isinstance(finding["line"], int)
+    assert "lint:" in data["delta"]
+
+
+# --- call-graph reachability --------------------------------------------------
+
+
+def _graph(tmp_path, files):
+    pkg = tmp_path / "cgpkg"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, text in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return build_callgraph(load_tree(pkg, rel_prefix="cgpkg"))
+
+
+def test_callgraph_direct_call_reachability(tmp_path):
+    g = _graph(tmp_path, {"a.py": """
+        import jax
+
+        def leaf():
+            return 1
+
+        def root(x):
+            return leaf() + x
+
+        fast = jax.jit(root)
+    """})
+    reach = g.reachable_from_traced()
+    assert "cgpkg/a.py::root" in reach
+    assert "cgpkg/a.py::leaf" in reach
+    assert reach["cgpkg/a.py::leaf"] == [
+        "cgpkg/a.py::root", "cgpkg/a.py::leaf",
+    ]
+
+
+def test_callgraph_aliased_import_reachability(tmp_path):
+    g = _graph(tmp_path, {
+        "helpers.py": """
+            def impure():
+                return 1
+        """,
+        "b.py": """
+            import jax
+            from cgpkg.helpers import impure as imp
+
+            def root(x):
+                return imp() + x
+
+            fast = jax.jit(root)
+        """,
+    })
+    reach = g.reachable_from_traced()
+    assert "cgpkg/helpers.py::impure" in reach
+
+
+def test_callgraph_method_call_reachability(tmp_path):
+    g = _graph(tmp_path, {"c.py": """
+        import jax
+
+        class Engine:
+            def helper(self):
+                return 2
+
+            @jax.jit
+            def apply(self, x):
+                return self.helper() * x
+    """})
+    reach = g.reachable_from_traced()
+    assert "cgpkg/c.py::Engine.apply" in reach
+    assert "cgpkg/c.py::Engine.helper" in reach
+
+
+def test_callgraph_lambda_and_nested_roots(tmp_path):
+    g = _graph(tmp_path, {"d.py": """
+        import jax
+
+        def outer():
+            def inner(x):
+                return x + 1
+            return jax.vmap(inner)
+    """})
+    assert "cgpkg/d.py::outer.inner" in g.reachable_from_traced()
+
+
+def test_unknown_rule_id_raises(tmp_path):
+    cfg = make_repo(tmp_path, {"mod.py": "x = 1\n"})
+    with pytest.raises(ValueError, match="QFX999"):
+        run_lint(config=cfg, rules=("QFX999",))
