@@ -91,7 +91,12 @@ Sections in ``bench_details.json`` (beyond the headline):
   Since r17 the headline row profiles the scanned program head-to-head
   with the r07-fused one (``ops_per_step_vs_fused``), plus a ``depth6``
   L=6 pair: the scanned body is depth-invariant, so the collapse
-  factor rises with L and the L=3 headline is its floor (§17).
+  factor rises with L and the L=3 headline is its floor (§17). r19
+  adds the third arm — ``pallas`` (QFEDX_PALLAS=1, the scan body as
+  ONE kernel) — and ``route_resolved`` fuse/scan/pallas booleans so
+  the snapshot is self-describing; off-chip the pallas arm runs
+  interpreted (flagged) and the kernel judgement is the static
+  TPU-lowered census (§18).
 - ``time_to_target`` / ``time_to_target_20q``: wall-clock to target
   accuracy, flagship 8q config and the TRUE 20-qubit config-5 width
   (VERDICT r04 missing 1: 20q had been timed but never trained).
@@ -1154,11 +1159,35 @@ def _bench_floor_attribution(jax):
         {**route, "QFEDX_SCAN_LAYERS": "off"}, profile_one
     )
     row["route"] = "scanned"
+    # The resolved fuse/scan/pallas booleans of the HEADLINE row's env —
+    # snapshots are self-describing (r19): a future reader must not have
+    # to reconstruct what an unset pin defaulted to on this backend.
+    from qfedx_tpu.ops.pallas_body import resolved_route
+
+    row["route_resolved"] = _with_env(route, resolved_route)
     row["r07_fused"] = {
         k: fused.get(k)
         for k in ("static_state_ops", "ops_per_step", "gap_us_per_op",
                   "gap_p95_us", "device_busy_fraction")
     }
+    # r19 third arm: the SAME program with the scan body as one Pallas
+    # kernel (QFEDX_PALLAS=1). On the chip this is the kernel the route
+    # defaults to; off-chip pallas_call runs INTERPRETED — the executed
+    # census then measures the interpreter, not the kernel, so the row
+    # carries the ``interpreted`` flag and the honest judgement lives in
+    # the static TPU-lowered census (tests/test_obs_hlo.py pins pallas
+    # 279 < scanned 336 state ops at n=12; docs/PERF.md §18).
+    pallas = _with_env({**route, "QFEDX_PALLAS": "1"}, profile_one)
+    row["pallas"] = {
+        k: pallas.get(k)
+        for k in ("static_state_ops", "ops_per_step", "gap_us_per_op",
+                  "gap_p95_us", "device_busy_fraction")
+    }
+    row["pallas"]["interpreted"] = not on_chip
+    if pallas.get("ops_per_step") and row.get("ops_per_step"):
+        row["pallas"]["ops_per_step_vs_scanned"] = round(
+            row["ops_per_step"] / pallas["ops_per_step"], 2
+        )
     if row.get("ops_per_step") and fused.get("ops_per_step"):
         row["ops_per_step_vs_fused"] = round(
             fused["ops_per_step"] / row["ops_per_step"], 2
@@ -1792,6 +1821,18 @@ def main():
                     prev_floor.get("ops_per_step"),
                     False,
                 )
+                # r19 pallas arm: only comparable kernel-vs-kernel —
+                # an interpreted (off-chip) census against a chip one
+                # would flag the interpreter, not a regression.
+                now_p = floor_attr.get("pallas") or {}
+                prev_p = prev_floor.get("pallas") or {}
+                if now_p.get("interpreted") == prev_p.get("interpreted"):
+                    delta(
+                        "floor_pallas_ops_per_step",
+                        now_p.get("ops_per_step"),
+                        prev_p.get("ops_per_step"),
+                        False,
+                    )
             delta("compute_bound_fwd_grad_s", compute.get("fwd_grad_s"),
                   prev_engine_s("compute_bound", "n16"), False)
             delta("dense18q_fwd_grad_s", dense18.get("fwd_grad_s"),
@@ -2053,10 +2094,11 @@ def main():
                 "floor_attribution": {
                     k: floor_attr.get(k)
                     for k in (
-                        "n", "route", "ops_per_step", "static_state_ops",
-                        "measured_vs_static", "gap_us_per_op",
-                        "device_busy_fraction", "ops_per_step_vs_fused",
-                        "static_vs_fused", "depth6",
+                        "n", "route", "route_resolved", "ops_per_step",
+                        "static_state_ops", "measured_vs_static",
+                        "gap_us_per_op", "device_busy_fraction",
+                        "ops_per_step_vs_fused", "static_vs_fused",
+                        "depth6", "pallas",
                     )
                 }
                 if "error" not in floor_attr

@@ -1484,7 +1484,20 @@ def apply_scan(state: CArray, n: int, program: ScanProgram,
     front (exact zeros — bitwise-neutral through every complex
     shortcut) both to keep the carry structure layer-invariant and
     because a single while-loop buffer measurably halves XLA:CPU's
-    per-iteration carry copies (~14 executed slots/step at n=12)."""
+    per-iteration carry copies (~14 executed slots/step at n=12).
+
+    QFEDX_PALLAS (r19) escalates the same program one level further:
+    when the pin is on and the body is a kind set the Pallas kernel
+    emits, the WHOLE scan runs as one ``pallas_call`` whose state block
+    stays VMEM-resident across the layer grid (ops/pallas_body.py) —
+    the carry copies and xs slices this docstring budgets for vanish as
+    a class. Off (the default off-TPU) or unsupported, the branch below
+    is never entered and this function is the r17 program bit-for-bit
+    (lowered-text identity pinned in tests/test_pallas.py)."""
+    from qfedx_tpu.ops import pallas_body
+
+    if pallas_body.route_ok(state, n, program, batched):
+        return pallas_body.apply_scan_pallas(state, n, program, batched)
     state = CArray(state.re, state.imag_or_zeros())
     for op in program.pre:
         state = _exec_stacked(state, n, op, batched)

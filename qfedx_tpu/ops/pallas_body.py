@@ -1,0 +1,678 @@
+"""Pallas scan-body kernel: one fused super-layer as ONE on-chip kernel.
+
+Why (docs/PERF.md §17–18): r17 collapsed the per-step op count 4.9–6.7×
+by scanning ONE fused super-layer body, but the measured census shows
+~60% of the *remaining* executed slots are scan machinery — the packed
+(2, …) carry copied in and out of HBM every iteration plus the per-op
+xs slices. Those slots are not compute; they are the cost of expressing
+"keep the state where it is" in XLA's while-loop calling convention.
+Pallas can say it directly: a ``pallas_call`` whose grid iterates
+(state-block, layer) with the state block mapped to a CONSTANT output
+index stays VMEM-resident across the layer dimension — the carry
+copies and xs slices vanish as a class, and the layer's StackedOp
+sequence (lane matmul on the MXU, row-matrix contraction, diagonal
+phase mask, row-perm gather, glane/growmat controlled forms, the HEA
+wrap CNOT) applies back-to-back on-chip. The r17 layer-stacked
+``(L,…,128,128)``/``(L,…,R,R)`` artifacts are already the kernel's
+operand layout: each layer's coefficients arrive as one double-buffered
+BlockSpec block instead of a carry-threaded dynamic slice.
+
+Gradients do NOT repeat the r04 failure (the retired whole-circuit
+kernel's VPU-serial adjoint sweep, 24 ms of a 26.8 ms step — PERF §4):
+the body is LINEAR in the state, so the ``custom_vjp`` runs the SAME
+kernel over adjointed artifacts (conjugate-transposed branch matrices,
+conjugated masks, inverted permutations) in reverse layer order for the
+state cotangent, and coefficient cotangents come from the per-layer
+boundary states the forward kernel materializes anyway (the exact
+residuals ``lax.scan``'s own VJP saves), contracted as ordinary batched
+einsums OUTSIDE the kernel — ``jax.vjp`` of the vmapped pure-JAX layer
+body, so the contraction code cannot drift from the executors the scan
+route runs.
+
+Routing: ``QFEDX_PALLAS`` pins the route ("1"/"on", "0"/"off"); the
+default follows the backend (``utils/pins.tpu_backend_default``) like
+QFEDX_FUSE/QFEDX_SCAN_LAYERS, and the kernel only engages ON TOP of an
+active scan route — ``fuse.apply_scan`` consults ``route_ok`` per
+program, so ``QFEDX_PALLAS=0`` (or any unsupported program shape) is
+the r17 lax.scan program bit-for-bit (pinned by lowered-text identity
+in tests/test_pallas.py). Kraus channels and the sharded global-qubit
+barriers never reach here: channels are scan barriers upstream
+(models/vqc, fuse module docstring), so the kernel only ever sees pure
+unitary layer stacks. Off-TPU the call runs ``interpret=True`` — the
+tier-1 parity matrix (logits AND grads vs the scanned route, dense/
+batched/client-folded) rides the interpreter; on-chip evidence is
+bench.py's three-arm ``floor_attribution`` (pallas vs scanned vs
+r07-fused), judged under the r05 discipline: if the kernel loses where
+it was designed to win, it ships default-off with the measured
+post-mortem (PERF §18), not deleted evidence.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from qfedx_tpu.ops.cpx import CArray
+from qfedx_tpu.ops.statevector import _LANE_BITS, _LANES, _SLAB_MIN
+from qfedx_tpu.utils import pins
+
+
+def pallas_enabled() -> bool:
+    """Route scanned layer stacks through the Pallas body kernel?
+    QFEDX_PALLAS pins ("1"/"on" or "0"/"off"); default follows the
+    backend like QFEDX_FUSE/QFEDX_SCAN_LAYERS (the kernel is the TPU
+    production aspiration; off-TPU it would run interpreted). Read at
+    TRACE time — set it before the first trace, like every routing
+    pin."""
+    return pins.bool_pin("QFEDX_PALLAS", pins.tpu_backend_default)
+
+
+def resolved_route() -> dict:
+    """The fuse/scan/pallas route booleans as this process would trace
+    them NOW — the shared self-description snippet behind
+    ``ServeEngine.warmup()['route_resolved']``, ``qfedx inspect`` and
+    bench.py's compact rows (a pin snapshot alone can't say what an
+    unset pin defaulted to)."""
+    from qfedx_tpu.ops import fuse
+
+    fuse_on = fuse.fuse_enabled()
+    scan_on = fuse.scan_enabled() and fuse_on
+    return {
+        "fuse": fuse_on,
+        "scan_layers": scan_on,
+        "pallas": pallas_enabled() and scan_on,
+    }
+
+
+# Stacked body kinds the kernel can emit; anything else (a "g1"/"g2"
+# that survived fusion at sub-slab widths) falls back to lax.scan.
+_STACKED_KINDS = frozenset(
+    ("lane", "rowmat", "mask", "rowperm", "glane", "growmat", "rowpair")
+)
+# Layer-constant kinds with STATIC coefficients (the HEA ring CNOT, a
+# collapsed row permutation) — embedded in the kernel spec, never DMA'd.
+_STATIC_KINDS = frozenset(("cnot", "rowperm"))
+
+# Trailing gate-axis counts per stacked kind (below the optional group
+# axis), mirroring batched._coeff_groups' gate_ndim convention.
+_GATE_NDIM = {
+    "lane": 2, "rowmat": 2, "mask": 1,
+    "glane": 3, "growmat": 3, "rowpair": 4,
+}
+
+
+class _OpSpec(NamedTuple):
+    """Static (hashable) description of one body op — everything the
+    kernel builder needs except the traced coefficient values, which
+    ride the xs pytree through the custom_vjp boundary."""
+
+    kind: str
+    qubits: tuple
+    stacked: bool
+    groups: int            # coefficient groups (1 = shared)
+    has_im: bool           # stacked coefficients carry an imaginary part
+    perm: tuple | None     # static row permutation ("rowperm" only)
+
+
+class _KernelSpec(NamedTuple):
+    """Static description of one scanned-body kernel launch."""
+
+    n: int
+    length: int
+    tb: int                # state blocks in the grid (1 dense, B batched)
+    batched: bool
+    ops: tuple             # of _OpSpec, in execution order
+    interpret: bool
+
+
+def _op_groups(op, tb: int) -> int | None:
+    """Coefficient-group count of a stacked op against ``tb`` state
+    blocks (None = unsupported shape), with batched.apply_*'s G | B
+    contract."""
+    gate_ndim = _GATE_NDIM[op.kind]
+    lead = op.coeffs.re.ndim - 1 - gate_ndim  # minus the layer axis
+    if lead == 0:
+        return 1
+    if lead != 1:
+        return None
+    g = op.coeffs.re.shape[1]
+    if g <= 0 or tb % g != 0:
+        return None
+    return g
+
+
+def route_ok(state: CArray, n: int, program, batched: bool) -> bool:
+    """May THIS program run as the Pallas body kernel?  Consulted by
+    ``fuse.apply_scan`` per trace: the pin must be on, the width must be
+    a slab, and every body op must be a kind the kernel emits with a
+    group count that divides the state-block grid. A False here is the
+    r17 lax.scan program unchanged — unsupported shapes degrade, never
+    break."""
+    if not pallas_enabled():
+        return False
+    if n < _SLAB_MIN or program.length < 1 or not program.body:
+        return False
+    tb = state.re.shape[0] if batched else 1
+    for op in program.body:
+        if op.stacked:
+            if op.kind not in _STACKED_KINDS or op.kind == "rowperm":
+                return False
+            if not isinstance(op.coeffs, CArray):
+                return False
+            if _op_groups(op, tb) is None:
+                return False
+        else:
+            if op.kind not in _STATIC_KINDS:
+                return False
+            if op.kind == "cnot" and len(op.qubits) != 2:
+                return False
+    return True
+
+
+def _build_spec(state: CArray, n: int, program, batched: bool) -> _KernelSpec:
+    tb = state.re.shape[0] if batched else 1
+    ops = []
+    for op in program.body:
+        if op.stacked:
+            ops.append(_OpSpec(
+                op.kind, tuple(op.qubits), True,
+                _op_groups(op, tb), op.coeffs.im is not None, None,
+            ))
+        else:
+            perm = (
+                tuple(int(i) for i in np.asarray(op.coeffs))
+                if op.kind == "rowperm" else None
+            )
+            ops.append(_OpSpec(
+                op.kind, tuple(op.qubits), False, 1, False, perm,
+            ))
+    return _KernelSpec(
+        n=n, length=program.length, tb=tb, batched=batched,
+        ops=tuple(ops),
+        interpret=_interpret_default(),
+    )
+
+
+def _interpret_default() -> bool:
+    """Interpret the kernel off-TPU (tier-1's parity substrate); the
+    TPU-export census test monkeypatches this to pin the real Mosaic
+    lowering from a CPU host."""
+    return jax.default_backend() != "tpu"
+
+
+# --- static (trace-time) operand builders -----------------------------------
+#
+# Pallas kernels may not capture array constants — every non-scalar
+# static operand (the rowperm gather indices, the lane-CNOT permutation
+# matrices) enters as an INPUT with a constant index_map, so it is
+# DMA'd once and stays VMEM-resident like the state block. Pure bit-
+# flip row permutations need no operand at all: they emit as reshape +
+# flip on leading (sublane) axes, the minor 128-lane dim untouched.
+
+
+def _np_lane_cnot(n: int, ctrl: int, tgt: int) -> np.ndarray:
+    """(128,128) Mt for a lane-lane CNOT (statevector._lane_perm_cnot's
+    numpy twin — symmetric involution, so it is its own adjoint)."""
+    pc, pt = n - 1 - ctrl, n - 1 - tgt
+    j = np.arange(_LANES)[:, None]
+    l = np.arange(_LANES)[None, :]
+    t = np.where(((j >> pc) & 1) == 1, j ^ (1 << pt), j)
+    return (l == t).astype(np.float32)
+
+
+def _np_lane_flip(n: int, tgt: int) -> np.ndarray:
+    """(128,128) symmetric permutation flipping lane bit of ``tgt``."""
+    p = n - 1 - tgt
+    j = np.arange(_LANES)[:, None]
+    l = np.arange(_LANES)[None, :]
+    return (j == (l ^ (1 << p))).astype(np.float32)
+
+
+def _static_arrays(spec: _KernelSpec, op: _OpSpec, dtype) -> list:
+    """The static VMEM operands ``op`` consumes, in kernel ref order."""
+    n = spec.n
+    rbits = n - _LANE_BITS
+    if op.kind == "rowperm":
+        return [np.asarray(op.perm, dtype=np.int32)]
+    if op.kind == "cnot":
+        ctrl, tgt = op.qubits
+        c_row, t_row = ctrl < rbits, tgt < rbits
+        if not c_row and not t_row:
+            return [_np_lane_cnot(n, ctrl, tgt).astype(dtype)]
+        if c_row and not t_row:
+            return [_np_lane_flip(n, tgt).astype(dtype)]
+    return []
+
+
+# --- the kernel body --------------------------------------------------------
+
+
+def _row_flip(x, rbits: int, qubit: int):
+    """Flip row bit of ``qubit`` on an (R, 128) value: reshape to the
+    (a, 2, c, 128) split and swap the bit axis's two halves — static
+    slices + concatenate on leading (sublane) axes, the minor lane dim
+    untouched (Mosaic has no ``rev``; this is the lowering-supported
+    spelling of a single-bit row permutation)."""
+    a = 1 << qubit
+    c = 1 << (rbits - qubit - 1)
+    v = x.reshape(a, 2, c, _LANES)
+    return jnp.concatenate(
+        [v[:, 1:2], v[:, 0:1]], axis=1
+    ).reshape(1 << rbits, _LANES)
+
+
+def _emit(spec: _KernelSpec, op: _OpSpec, sre, sim, cre, cim, statics):
+    """Emit one body op on the VMEM-resident (R, 128) pair. Every form
+    is matmul, elementwise, leading-axis reshape/flip, or iota-bit
+    select — shapes the Mosaic lowering and the interpreter both take
+    without layout surgery; the one gather (rowperm) reads its index
+    vector from a resident static operand."""
+    n = spec.n
+    rbits = n - _LANE_BITS
+    r = 1 << rbits
+    dt = sre.dtype
+
+    def dot(a, b):
+        return jnp.dot(
+            a, b, preferred_element_type=jnp.float32
+        ).astype(dt)
+
+    def capply(f, xre, xim, mre, mim):
+        # f(x, m) linear in x; complex 4-case resolution as _matmul_lane
+        rr = f(xre, mre)
+        if mim is None:
+            return rr, f(xim, mre)
+        return rr - f(xim, mim), f(xim, mre) + f(xre, mim)
+
+    def row_bit(qubit):
+        i = jax.lax.broadcasted_iota(jnp.int32, (r, _LANES), 0)
+        return (i >> (rbits - 1 - qubit)) & 1
+
+    def lane_bit(qubit):
+        i = jax.lax.broadcasted_iota(jnp.int32, (r, _LANES), 1)
+        return (i >> (n - 1 - qubit)) & 1
+
+    def sel(bit, a0, a1):
+        return jnp.where(bit == 1, a1, a0)
+
+    if op.kind == "lane":
+        mre = cre[0, 0]
+        mim = None if cim is None else cim[0, 0]
+        return capply(lambda x, m: dot(x, m), sre, sim, mre, mim)
+
+    if op.kind == "rowmat":
+        mre = cre[0, 0]
+        mim = None if cim is None else cim[0, 0]
+        return capply(lambda x, m: dot(m, x), sre, sim, mre, mim)
+
+    if op.kind == "mask":
+        mre = cre[0, 0]
+        mim = None if cim is None else cim[0, 0]
+        return capply(lambda x, m: x * m, sre, sim, mre, mim)
+
+    if op.kind == "glane":
+        bit = row_bit(op.qubits[0])
+        outs = []
+        for x in (0, 1):
+            mre = cre[0, 0, x]
+            mim = None if cim is None else cim[0, 0, x]
+            outs.append(capply(lambda s, m: dot(s, m), sre, sim, mre, mim))
+        return sel(bit, outs[0][0], outs[1][0]), sel(
+            bit, outs[0][1], outs[1][1]
+        )
+
+    if op.kind == "growmat":
+        bit = lane_bit(op.qubits[0])
+        outs = []
+        for x in (0, 1):
+            mre = cre[0, 0, x]
+            mim = None if cim is None else cim[0, 0, x]
+            outs.append(capply(lambda s, m: dot(m, s), sre, sim, mre, mim))
+        return sel(bit, outs[0][0], outs[1][0]), sel(
+            bit, outs[0][1], outs[1][1]
+        )
+
+    if op.kind == "rowperm":
+        idx = statics[0][...]
+        return jnp.take(sre, idx, axis=0), jnp.take(sim, idx, axis=0)
+
+    if op.kind == "rowpair":
+        q1, q2 = op.qubits
+        b1, b2 = row_bit(q1), row_bit(q2)
+        o = b1 * 2 + b2
+
+        def pick(g, d):
+            # per-row coefficient g[o(r), o(r)^d]; g is the (4,4) block
+            v = g[0, 0, 3, 3 ^ d]
+            for a in (2, 1, 0):
+                v = jnp.where(o == a, g[0, 0, a, a ^ d], v)
+            return v
+
+        def flipped(x, d):
+            if d & 2:
+                x = _row_flip(x, rbits, q1)
+            if d & 1:
+                x = _row_flip(x, rbits, q2)
+            return x
+
+        acc_re = jnp.zeros((r, _LANES), dt)
+        acc_im = jnp.zeros((r, _LANES), dt)
+        for d in range(4):
+            xre, xim = flipped(sre, d), flipped(sim, d)
+            gre = pick(cre, d)
+            acc_re = acc_re + gre * xre
+            acc_im = acc_im + gre * xim
+            if cim is not None:
+                gim = pick(cim, d)
+                acc_re = acc_re - gim * xim
+                acc_im = acc_im + gim * xre
+        return acc_re, acc_im
+
+    if op.kind == "cnot":
+        ctrl, tgt = op.qubits
+        c_row, t_row = ctrl < rbits, tgt < rbits
+        if c_row and t_row:  # select(ctrl rows, tgt-bit flip, s)
+            bit = row_bit(ctrl)
+            return (
+                sel(bit, sre, _row_flip(sre, rbits, tgt)),
+                sel(bit, sim, _row_flip(sim, rbits, tgt)),
+            )
+        if not c_row and not t_row:  # resident permutation matmul
+            p = statics[0][...]
+            return dot(sre, p), dot(sim, p)
+        if c_row:  # row control, lane target: select(rows, s@P, s)
+            p = statics[0][...]
+            bit = row_bit(ctrl)
+            return sel(bit, sre, dot(sre, p)), sel(bit, sim, dot(sim, p))
+        # lane control, row target: select(lanes, tgt-bit flip, s)
+        bit = lane_bit(ctrl)
+        return (
+            sel(bit, sre, _row_flip(sre, rbits, tgt)),
+            sel(bit, sim, _row_flip(sim, rbits, tgt)),
+        )
+
+    raise ValueError(f"pallas body cannot emit op kind {op.kind!r}")
+
+
+def _make_kernel(spec: _KernelSpec, with_boundaries: bool):
+    """The kernel: grid (tb, L), layer minor, so the state block mapped
+    to a CONSTANT (over L) output index stays VMEM-resident while every
+    layer applies — pl.when(l == 0) seeds it from the input block, each
+    step read-modify-writes it in place, and (under differentiation)
+    each step first snapshots the layer-entry state to the boundary
+    output (the custom_vjp residuals)."""
+    from jax.experimental import pallas as pl
+
+    n_coeff = sum(
+        (2 if op.has_im else 1) for op in spec.ops if op.stacked
+    )
+    n_static = sum(
+        len(_static_arrays(spec, op, np.float32)) for op in spec.ops
+    )
+
+    def kernel(*refs):
+        in_re, in_im = refs[0], refs[1]
+        crefs = refs[2:2 + n_coeff]
+        srefs = refs[2 + n_coeff:2 + n_coeff + n_static]
+        base = 2 + n_coeff + n_static
+        out_re, out_im = refs[base], refs[base + 1]
+        layer = pl.program_id(1)
+
+        @pl.when(layer == 0)
+        def _seed():
+            out_re[...] = in_re[...]
+            out_im[...] = in_im[...]
+
+        if with_boundaries:
+            bnd_re, bnd_im = refs[base + 2], refs[base + 3]
+            bnd_re[0] = out_re[...]
+            bnd_im[0] = out_im[...]
+        sre, sim = out_re[0], out_im[0]
+        it = iter(crefs)
+        sit = iter(srefs)
+        for op in spec.ops:
+            cre = cim = None
+            if op.stacked:
+                cre = next(it)
+                cim = next(it) if op.has_im else None
+            statics = [
+                next(sit)
+                for _ in _static_arrays(spec, op, np.float32)
+            ]
+            sre, sim = _emit(spec, op, sre, sim, cre, cim, statics)
+        out_re[0] = sre
+        out_im[0] = sim
+
+    return kernel
+
+
+def _coeff_operands(spec: _KernelSpec, xs, dtype):
+    """Normalize the stacked coefficient stacks into kernel operand
+    layout — (L, G, …gate) with masks reshaped to slab (R, 128) blocks
+    and rowpair tensors flattened to (4, 4) — plus the matching
+    BlockSpecs (per-layer block l, group ``b·G/tb``: Pallas' automatic
+    double-buffered DMA replaces the scan's xs slices)."""
+    from jax.experimental import pallas as pl
+
+    rbits = spec.n - _LANE_BITS
+    r = 1 << rbits
+    arrays, specs = [], []
+    it = iter(xs)
+    for op in spec.ops:
+        if not op.stacked:
+            continue
+        c = next(it)
+        base = {
+            "lane": (_LANES, _LANES), "rowmat": (r, r),
+            "mask": (r, _LANES), "glane": (2, _LANES, _LANES),
+            "growmat": (2, r, r), "rowpair": (4, 4),
+        }[op.kind]
+
+        def norm(x):
+            x = x.astype(dtype)
+            return x.reshape((spec.length, op.groups) + base)
+
+        def idx(b, l, g=op.groups, nb=len(base)):
+            return (l, b * g // spec.tb) + (0,) * nb
+
+        block = pl.BlockSpec((1, 1) + base, idx)
+        arrays.append(norm(c.re))
+        specs.append(block)
+        if op.has_im:
+            arrays.append(norm(c.im))
+            specs.append(block)
+    return arrays, specs
+
+
+def _run(spec: _KernelSpec, packed, xs, with_boundaries: bool):
+    """Launch the kernel on a packed (2, tb, R, 128) state; returns the
+    final packed state (and the packed (L, 2, tb, R, 128) layer-entry
+    boundary states under ``with_boundaries``)."""
+    from jax.experimental import pallas as pl
+
+    r = 1 << (spec.n - _LANE_BITS)
+    dt = packed.dtype
+    state_block = pl.BlockSpec((1, r, _LANES), lambda b, l: (b, 0, 0))
+    coeffs, coeff_specs = _coeff_operands(spec, xs, dt)
+    statics, static_specs = [], []
+    for op in spec.ops:
+        for arr in _static_arrays(spec, op, dt):
+            statics.append(jnp.asarray(arr))
+            static_specs.append(pl.BlockSpec(
+                arr.shape, lambda b, l, nd=arr.ndim: (0,) * nd
+            ))
+    out_shapes = [
+        jax.ShapeDtypeStruct((spec.tb, r, _LANES), dt),
+        jax.ShapeDtypeStruct((spec.tb, r, _LANES), dt),
+    ]
+    out_specs = [state_block, state_block]
+    if with_boundaries:
+        bnd_block = pl.BlockSpec(
+            (1, 1, r, _LANES), lambda b, l: (l, b, 0, 0)
+        )
+        out_shapes += [
+            jax.ShapeDtypeStruct((spec.length, spec.tb, r, _LANES), dt),
+            jax.ShapeDtypeStruct((spec.length, spec.tb, r, _LANES), dt),
+        ]
+        out_specs += [bnd_block, bnd_block]
+    outs = pl.pallas_call(
+        _make_kernel(spec, with_boundaries),
+        grid=(spec.tb, spec.length),
+        in_specs=[state_block, state_block] + coeff_specs + static_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=spec.interpret,
+    )(packed[0], packed[1], *coeffs, *statics)
+    final = jnp.stack([outs[0], outs[1]])
+    if not with_boundaries:
+        return final, None
+    return final, jnp.stack([outs[2], outs[3]], axis=1)
+
+
+# --- custom_vjp: same kernel, adjointed artifacts, reversed ----------------
+
+
+def _adjoint_spec(spec: _KernelSpec) -> _KernelSpec:
+    """The bwd launch's spec: op order reversed, static permutations
+    inverted (CNOTs are involutions — unchanged)."""
+    ops = []
+    for op in reversed(spec.ops):
+        perm = op.perm
+        if op.kind == "rowperm" and perm is not None:
+            inv = np.empty(len(perm), dtype=np.int64)
+            inv[np.asarray(perm)] = np.arange(len(perm))
+            perm = tuple(int(i) for i in inv)
+        ops.append(op._replace(perm=perm))
+    return spec._replace(ops=tuple(ops))
+
+
+def _adjoint_xs(spec: _KernelSpec, xs) -> tuple:
+    """Adjointed coefficient stacks, reversed to match _adjoint_spec:
+    branch matrices conjugate-transposed, masks conjugated, the layer
+    axis flipped (the bwd kernel walks layers in reverse). The body is
+    linear in the state, so this is the WHOLE state-cotangent story —
+    no serial adjoint sweep (the r04 post-mortem, PERF §4)."""
+    out = []
+    it = iter(xs)
+    stacked = [op for op in spec.ops if op.stacked]
+    for op in stacked:
+        c = next(it)
+        re, im = c.re, c.im
+        if op.kind == "mask":
+            im = None if im is None else -im
+        elif op.kind == "rowpair":
+            # G'[o, i] = conj(G[i, o]) on the paired (2,2,2,2) axes
+            def tp(x):
+                return jnp.swapaxes(jnp.swapaxes(x, -4, -2), -3, -1)
+
+            re = tp(re)
+            im = None if im is None else -tp(im)
+        else:  # lane / rowmat / glane / growmat: M† per branch
+            re = jnp.swapaxes(re, -1, -2)
+            im = None if im is None else -jnp.swapaxes(im, -1, -2)
+        re = jnp.flip(re, axis=0)
+        im = None if im is None else jnp.flip(im, axis=0)
+        out.append(CArray(re, im))
+    return tuple(reversed(out))
+
+
+def _layer_exec(spec: _KernelSpec, packed, sliced):
+    """ONE layer of the scanned body in pure JAX — byte-identical op
+    dispatch to fuse.apply_scan's scan body (same _exec_stacked
+    executors). The bwd pass vmaps this over the boundary states and
+    takes its jax.vjp for the coefficient cotangents: the contraction
+    einsums are generated from the SAME code the lax.scan route runs,
+    so they cannot drift from it."""
+    from qfedx_tpu.ops import fuse
+
+    r = 1 << (spec.n - _LANE_BITS)
+    eng_shape = (
+        (spec.tb, 1 << spec.n) if spec.batched else (2,) * spec.n
+    )
+    st = CArray(
+        packed[0].reshape(eng_shape), packed[1].reshape(eng_shape)
+    )
+    it = iter(sliced)
+    for op in spec.ops:
+        if op.stacked:
+            coeffs = next(it)
+        elif op.kind == "rowperm":
+            coeffs = np.asarray(op.perm)
+        else:
+            coeffs = None
+        st = fuse._exec_stacked(
+            st, spec.n,
+            fuse.StackedOp(op.kind, op.qubits, coeffs, False),
+            spec.batched,
+        )
+    return jnp.stack([
+        st.re.reshape(spec.tb, r, _LANES),
+        st.im.reshape(spec.tb, r, _LANES),
+    ])
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _pallas_scan(spec: _KernelSpec, packed, xs):
+    final, _ = _run(spec, packed, xs, with_boundaries=False)
+    return final
+
+
+def _pallas_scan_fwd(spec, packed, xs):
+    final, boundaries = _run(spec, packed, xs, with_boundaries=True)
+    return final, (boundaries, xs)
+
+
+def _pallas_scan_bwd(spec, residuals, cot):
+    boundaries, xs = residuals
+    # State cotangent: the SAME kernel over adjointed artifacts in
+    # reverse — its boundary output is the per-layer OUTPUT cotangent
+    # stack C (C[l] = cotangent of layer l's output) once un-reversed.
+    axs = _adjoint_xs(spec, xs)
+    state_cot, cbnd = _run(
+        _adjoint_spec(spec), cot, axs, with_boundaries=True
+    )
+    c_out = jnp.flip(cbnd, axis=0)
+
+    # Coefficient cotangents: ordinary batched einsums outside the
+    # kernel — vjp of the vmapped pure-JAX layer body against C, with
+    # the boundary states as the (constant) layer inputs. This is the
+    # standard checkpoint decomposition: dL/dxs[l] = (∂out_l/∂xs[l])ᵀ
+    # C[l]; upstream dependence of the boundaries on earlier layers is
+    # already inside C.
+    def layers(bnd, xs_):
+        return jax.vmap(partial(_layer_exec, spec))(bnd, xs_)
+
+    _, vjp_fn = jax.vjp(layers, boundaries, xs)
+    _, xs_bar = vjp_fn(c_out)
+    return state_cot, xs_bar
+
+
+_pallas_scan.defvjp(_pallas_scan_fwd, _pallas_scan_bwd)
+
+
+def apply_scan_pallas(state: CArray, n: int, program,
+                      batched: bool = False) -> CArray:
+    """Run a stacked fused program with the scanned body as ONE Pallas
+    kernel launch (``fuse.apply_scan``'s kernel twin — same pre-op
+    hoisting, same xs discipline, the lax.scan replaced by the grid).
+    Callers route through ``fuse.apply_scan``; this entry assumes
+    ``route_ok`` already said yes."""
+    from qfedx_tpu.ops import fuse
+
+    state = CArray(state.re, state.imag_or_zeros())
+    for op in program.pre:
+        state = fuse._exec_stacked(state, n, op, batched)
+    spec = _build_spec(state, n, program, batched)
+    xs = tuple(op.coeffs for op in program.body if op.stacked)
+    r = 1 << (n - _LANE_BITS)
+    shape = state.re.shape
+    packed = jnp.stack([
+        state.re.reshape(spec.tb, r, _LANES),
+        state.im.reshape(spec.tb, r, _LANES),
+    ])
+    out = _pallas_scan(spec, packed, xs)
+    return CArray(out[0].reshape(shape), out[1].reshape(shape))

@@ -710,6 +710,12 @@ def run_inspect(run_dir) -> dict:
         "rounds_skipped": sum(1 for r in rows if r.get("skipped")),
         "ledger": ledger,
     }
+    # The fuse/scan/pallas chain as THIS process would trace it — the
+    # inspecting host's answer, a self-description of any snapshot taken
+    # from here (the run's own raw pins live in config.json).
+    from qfedx_tpu.ops.pallas_body import resolved_route
+
+    out["route"] = resolved_route()
     # Artifact problems are tracked apart from metrics-row validation:
     # invalid_rows (already in `out`) counts metrics.jsonl records only,
     # and a truncated summary.json must still show up in the JSON line.
@@ -758,6 +764,7 @@ def run_inspect(run_dir) -> dict:
         f"(best {out['best_accuracy']})")
     if ledger:
         say("[qfedx_tpu] ledger: " + json.dumps(ledger))
+    say("[qfedx_tpu] route: " + json.dumps(out["route"]))
     if "floor_attribution" in out:
         say("[qfedx_tpu] floor: " + json.dumps(out["floor_attribution"]))
     for problem in invalid[:5]:

@@ -205,10 +205,14 @@ class ServeEngine:
         # The engine-routing pins (serve/forward.py) of the programs
         # just compiled: ``route`` is the raw env snapshot (exact repro
         # of this process's routing key), ``route_resolved`` the
-        # backend-defaulted answers — on a default deploy every raw pin
-        # is "" and only the resolved values say whether the bucket
-        # floor is the r17 scanned or the per-layer program.
-        from qfedx_tpu.ops import fuse
+        # backend-defaulted answers for the fuse/scan/pallas chain
+        # (each conjoined with the one below it — pallas_body
+        # .resolved_route) — on a default deploy every raw pin is ""
+        # and only the resolved values say whether the bucket floor is
+        # the kernel, the r17 scan, or the per-layer program. Width/
+        # depth gates (fuse.scan_active, route_ok) live below the
+        # engine — models are opaque callables here.
+        from qfedx_tpu.ops import pallas_body
         from qfedx_tpu.ops.cpx import state_dtype
 
         return {
@@ -217,12 +221,7 @@ class ServeEngine:
             "route": {p: pins.str_pin(p, "") for p in _ROUTING_PINS},
             "route_resolved": {
                 "dtype": np.dtype(state_dtype()).name,
-                "fuse": fuse.fuse_enabled(),
-                # Conjoined with fuse: the scan route is built ON the
-                # fused forms and can never engage without them. Width/
-                # depth gates (fuse.scan_active) live below the engine —
-                # models are opaque callables here.
-                "scan_layers": fuse.scan_enabled() and fuse.fuse_enabled(),
+                **pallas_body.resolved_route(),
             },
         }
 

@@ -54,6 +54,7 @@ _ROUTING_PINS = (
     "QFEDX_DTYPE",
     "QFEDX_FUSE",
     "QFEDX_SCAN_LAYERS",
+    "QFEDX_PALLAS",
     "QFEDX_BATCHED",
     "QFEDX_GATE_FORM",
     "QFEDX_SLAB_LANES",

@@ -502,3 +502,88 @@ def test_unknown_rule_id_raises(tmp_path):
     cfg = make_repo(tmp_path, {"mod.py": "x = 1\n"})
     with pytest.raises(ValueError, match="QFX999"):
         run_lint(config=cfg, rules=("QFX999",))
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel idioms must not false-positive (r19 scan-body kernel)
+# ---------------------------------------------------------------------------
+#
+# ops/pallas_body.py reintroduced Pallas in r19. Kernel bodies are full of
+# idioms that superficially resemble lint violations: ``pl.program_id`` looks
+# like a runtime-environment read, ``@pl.when`` wraps a nested def whose only
+# job is a side effect, and the kernel communicates exclusively by mutating
+# Ref arguments (``o_ref[...] = value``) from inside a jitted pallas_call.
+# These fixtures pin that QFX001 (trace purity) and QFX003 (span discipline)
+# stay quiet on that shape of code.
+
+_PALLAS_KERNEL_MODULE = """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+
+    def _kernel(in_re, in_im, out_re, out_im, bnd_re):
+        layer = pl.program_id(1)
+
+        @pl.when(layer == 0)
+        def _seed():
+            out_re[...] = in_re[...]
+            out_im[...] = in_im[...]
+
+        bnd_re[0] = out_re[...]
+        sre = out_re[...]
+        sim = out_im[...]
+        out_re[...] = sre - sim
+        out_im[...] = sre + sim
+
+
+    @jax.jit
+    def run(packed):
+        return pl.pallas_call(
+            _kernel,
+            out_shape=[
+                jax.ShapeDtypeStruct(packed.shape[1:], packed.dtype),
+                jax.ShapeDtypeStruct(packed.shape[1:], packed.dtype),
+                jax.ShapeDtypeStruct((1,) + packed.shape[1:], packed.dtype),
+            ],
+            grid=(1, 1),
+        )(packed[0], packed[1])
+"""
+
+
+def test_qfx001_quiet_on_pallas_kernel_idioms(tmp_path):
+    # program_id reads, pl.when-wrapped nested defs, and Ref mutation are
+    # all trace-pure: nothing here escapes to the host environment.
+    assert findings_for(tmp_path, "QFX001", {"kern.py": _PALLAS_KERNEL_MODULE}) == []
+
+
+def test_qfx003_quiet_on_pallas_kernel_with_spans(tmp_path):
+    # A with-item span wrapping a pallas_call launch, plus Ref-mutation
+    # idioms inside the kernel, must not trip the span-discipline rule.
+    src = """
+        import jax
+        from jax.experimental import pallas as pl
+        from qfedx_tpu.utils import obs
+
+
+        def _kernel(x_ref, o_ref):
+            i = pl.program_id(0)
+
+            @pl.when(i == 0)
+            def _init():
+                o_ref[...] = x_ref[...]
+
+            o_ref[...] = o_ref[...] * 2.0
+
+
+        def launch(x):
+            with obs.span("pallas.launch"):
+                return pl.pallas_call(
+                    _kernel,
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    grid=(1,),
+                )(x)
+    """
+    assert findings_for(tmp_path, "QFX003", {"kern.py": src}) == []

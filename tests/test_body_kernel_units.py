@@ -1,0 +1,219 @@
+"""Unit tests for the r19 Pallas scan-body kernel's building blocks.
+
+``tests/test_pallas.py`` pins the kernel end-to-end (interpret-mode
+logits/grad parity against the lax.scan route); these are the fast
+unit-level pins for the pieces that parity would only implicate
+indirectly — the no-operand bit-flip spelling of row permutations, the
+numpy twins of the lane CNOT matrices, the static-operand selection per
+CNOT register placement, the adjoint spec/coefficient transforms the
+custom_vjp bwd launch is built from, and the coefficient-group
+contract ``route_ok`` enforces. All eager, no pallas_call, no jit — a
+wrong sign or a missed transpose fails HERE with a readable name
+instead of as an opaque parity diff.
+"""
+
+import os
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import jax.numpy as jnp
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from qfedx_tpu.ops import pallas_body as pb  # noqa: E402
+from qfedx_tpu.ops.cpx import CArray  # noqa: E402
+
+_LANES = 128
+
+
+def _bit_flip_ref(x, rbits, qubit):
+    # Independent reference: row index r maps to r with the (row-local,
+    # MSB-first) ``qubit`` bit flipped.
+    idx = np.arange(1 << rbits) ^ (1 << (rbits - qubit - 1))
+    return np.asarray(x)[idx]
+
+
+def test_row_flip_matches_index_xor_reference():
+    rng = np.random.default_rng(0)
+    for rbits, qubit in ((3, 0), (3, 2), (5, 1), (1, 0)):
+        x = rng.normal(size=(1 << rbits, _LANES)).astype(np.float32)
+        out = np.asarray(pb._row_flip(jnp.asarray(x), rbits, qubit))
+        np.testing.assert_array_equal(out, _bit_flip_ref(x, rbits, qubit))
+
+
+def test_row_flip_is_an_involution():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(8, _LANES)).astype(np.float32))
+    twice = pb._row_flip(pb._row_flip(x, 3, 1), 3, 1)
+    np.testing.assert_array_equal(np.asarray(twice), np.asarray(x))
+
+
+def test_lane_cnot_matrix_is_the_statevector_permutation():
+    # s @ M must equal the index-map CNOT: lane l reads from l with the
+    # target bit flipped when its control bit is set.
+    n, ctrl, tgt = 12, 7, 9
+    m = pb._np_lane_cnot(n, ctrl, tgt)
+    pc, pt = n - 1 - ctrl, n - 1 - tgt
+    l = np.arange(_LANES)
+    src = np.where(((l >> pc) & 1) == 1, l ^ (1 << pt), l)
+    rng = np.random.default_rng(2)
+    s = rng.normal(size=(_LANES,)).astype(np.float32)
+    np.testing.assert_array_equal(s @ m, s[src])
+    # Symmetric involution: its own transpose AND its own inverse, so
+    # the adjoint launch reuses the forward operand unchanged.
+    np.testing.assert_array_equal(m, m.T)
+    np.testing.assert_array_equal(m @ m, np.eye(_LANES, dtype=m.dtype))
+
+
+def test_lane_flip_matrix_is_a_symmetric_involution():
+    m = pb._np_lane_flip(12, 8)
+    p = 12 - 1 - 8
+    l = np.arange(_LANES)
+    rng = np.random.default_rng(3)
+    s = rng.normal(size=(_LANES,)).astype(np.float32)
+    np.testing.assert_array_equal(s @ m, s[l ^ (1 << p)])
+    np.testing.assert_array_equal(m, m.T)
+    np.testing.assert_array_equal(m @ m, np.eye(_LANES, dtype=m.dtype))
+
+
+def _spec(n=12, ops=()):
+    return pb._KernelSpec(
+        n=n, length=2, tb=1, batched=False, ops=tuple(ops),
+        interpret=True,
+    )
+
+
+def _cnot(ctrl, tgt):
+    return pb._OpSpec("cnot", (ctrl, tgt), False, 1, False, None)
+
+
+def test_static_arrays_per_cnot_register_placement():
+    # n=12 → rbits=5: qubits 0–4 live on the row axis, 5–11 on lanes.
+    spec = _spec()
+    # row-row and lane-ctrl/row-tgt emit as bit-flip reshapes — no
+    # operand; lane-lane and row-ctrl/lane-tgt need their (128,128)
+    # permutation matrix DMA'd in.
+    assert pb._static_arrays(spec, _cnot(0, 1), np.float32) == []
+    assert pb._static_arrays(spec, _cnot(9, 2), np.float32) == []
+    (lane_lane,) = pb._static_arrays(spec, _cnot(5, 8), np.float32)
+    np.testing.assert_array_equal(lane_lane, pb._np_lane_cnot(12, 5, 8))
+    (lane_flip,) = pb._static_arrays(spec, _cnot(2, 9), np.float32)
+    np.testing.assert_array_equal(lane_flip, pb._np_lane_flip(12, 9))
+
+
+def test_static_arrays_rowperm_is_an_int32_gather_operand():
+    op = pb._OpSpec("rowperm", (), False, 1, False, (2, 0, 3, 1))
+    (idx,) = pb._static_arrays(_spec(), op, np.float32)
+    assert idx.dtype == np.int32
+    np.testing.assert_array_equal(idx, [2, 0, 3, 1])
+
+
+def test_adjoint_spec_reverses_ops_and_inverts_rowperm():
+    perm_op = pb._OpSpec("rowperm", (), False, 1, False, (2, 0, 1))
+    lane_op = pb._OpSpec("lane", (8,), True, 1, True, None)
+    spec = _spec(ops=(perm_op, _cnot(0, 1), lane_op))
+    adj = pb._adjoint_spec(spec)
+    assert [o.kind for o in adj.ops] == ["lane", "cnot", "rowperm"]
+    # (2,0,1) sends 0→2, 1→0, 2→1; its inverse is (1,2,0). CNOTs are
+    # involutions and pass through untouched.
+    assert adj.ops[-1].perm == (1, 2, 0)
+    assert adj.ops[1] == _cnot(0, 1)
+    # Adjoint of the adjoint restores the forward spec exactly.
+    assert pb._adjoint_spec(adj) == spec
+
+
+def test_adjoint_xs_conjugates_transposes_and_flips_layers():
+    mask_op = pb._OpSpec("mask", (), True, 1, True, None)
+    lane_op = pb._OpSpec("lane", (8,), True, 1, True, None)
+    spec = _spec(ops=(mask_op, lane_op))
+    rng = np.random.default_rng(4)
+    mask = CArray(
+        jnp.asarray(rng.normal(size=(2, 4)), jnp.float32),
+        jnp.asarray(rng.normal(size=(2, 4)), jnp.float32),
+    )
+    lane = CArray(
+        jnp.asarray(rng.normal(size=(2, 2, 2)), jnp.float32),
+        jnp.asarray(rng.normal(size=(2, 2, 2)), jnp.float32),
+    )
+    adj_lane, adj_mask = pb._adjoint_xs(spec, (mask, lane))
+    # Masks are diagonal: adjoint = conjugate, layers reversed.
+    np.testing.assert_array_equal(
+        np.asarray(adj_mask.re), np.asarray(mask.re)[::-1]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(adj_mask.im), -np.asarray(mask.im)[::-1]
+    )
+    # Branch matrices: M† per layer (conjugate transpose), reversed.
+    np.testing.assert_array_equal(
+        np.asarray(adj_lane.re),
+        np.asarray(lane.re)[::-1].transpose(0, 2, 1),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(adj_lane.im),
+        -np.asarray(lane.im)[::-1].transpose(0, 2, 1),
+    )
+
+
+def test_adjoint_xs_rowpair_swaps_the_paired_axes():
+    op = pb._OpSpec("rowpair", (0, 2), True, 1, True, None)
+    rng = np.random.default_rng(5)
+    c = CArray(
+        jnp.asarray(rng.normal(size=(2, 2, 2, 2, 2)), jnp.float32),
+        jnp.asarray(rng.normal(size=(2, 2, 2, 2, 2)), jnp.float32),
+    )
+    (adj,) = pb._adjoint_xs(_spec(ops=(op,)), (c,))
+    # G'[..., o1, o2, i1, i2] = conj(G[..., i1, i2, o1, o2]) with the
+    # layer axis flipped.
+    ref = np.asarray(c.re)[::-1].transpose(0, 3, 4, 1, 2)
+    np.testing.assert_array_equal(np.asarray(adj.re), ref)
+    ref_im = -np.asarray(c.im)[::-1].transpose(0, 3, 4, 1, 2)
+    np.testing.assert_array_equal(np.asarray(adj.im), ref_im)
+
+
+def _coeff_op(kind, shape):
+    return SimpleNamespace(
+        kind=kind, coeffs=SimpleNamespace(re=np.zeros(shape)),
+    )
+
+
+def test_op_groups_speaks_the_batched_group_contract():
+    # Shared coefficients (no group axis) → one group at any tb.
+    assert pb._op_groups(_coeff_op("lane", (3, 2, 2)), 8) == 1
+    # One leading group axis: G must divide the state-block count.
+    assert pb._op_groups(_coeff_op("lane", (3, 4, 2, 2)), 8) == 4
+    assert pb._op_groups(_coeff_op("lane", (3, 3, 2, 2)), 8) is None
+    # Two extra leading axes are not a shape the kernel packs.
+    assert pb._op_groups(_coeff_op("lane", (3, 2, 4, 2, 2)), 8) is None
+    # Kind-specific gate ndim: rowpair carries 4 paired gate axes.
+    assert pb._op_groups(
+        _coeff_op("rowpair", (3, 2, 2, 2, 2, 2)), 8
+    ) == 2
+
+
+def test_route_ok_rejects_foreign_kinds_not_pallas_shapes(monkeypatch):
+    # A stacked rowperm (dynamic permutation coefficients) and a static
+    # kind outside {cnot, rowperm} both degrade to the lax.scan route —
+    # route_ok answers False instead of letting the builder throw.
+    monkeypatch.setenv("QFEDX_PALLAS", "1")
+    state = CArray(jnp.zeros((32, _LANES)), None)
+
+    def prog(body):
+        return SimpleNamespace(length=2, body=body)
+
+    stacked_rowperm = SimpleNamespace(
+        kind="rowperm", stacked=True, coeffs=None, qubits=(),
+    )
+    assert not pb.route_ok(state, 12, prog([stacked_rowperm]), False)
+    foreign = SimpleNamespace(
+        kind="kraus", stacked=False, coeffs=None, qubits=(0,),
+    )
+    assert not pb.route_ok(state, 12, prog([foreign]), False)
+    three_q = SimpleNamespace(
+        kind="cnot", stacked=False, coeffs=None, qubits=(0, 1, 2),
+    )
+    assert not pb.route_ok(state, 12, prog([three_q]), False)
+    # And the empty body never launches a kernel.
+    assert not pb.route_ok(state, 12, prog([]), False)

@@ -118,3 +118,67 @@ def test_profile_step_reexports():
 
     assert profile_step.count_state_ops is count_state_ops
     assert profile_step.module_counts is module_counts
+
+# ---------------------------------------------------------------------------
+# r19 Pallas scan-body kernel: the census guard goes cross-platform
+# ---------------------------------------------------------------------------
+#
+# The pallas route only lowers for the TPU backend (interpret mode runs the
+# kernel as a traced emulation, which the census would mis-count — the
+# interpreter INFLATES state ops). jax.export targets the TPU lowering from
+# this CPU container, so the guard measures the program the chip would
+# actually run: the whole super-layer body collapses into tpu_custom_call
+# slots and the scan carry-copy / xs-slice machinery around it disappears.
+# Measured on this container (n=12, L=2, B=4): pallas 279 state ops, 2
+# custom calls, 1 while loop vs scanned 336 / 0 / 3.
+
+
+def _tpu_lowered_text(monkeypatch, pallas_pin: str, n=12, layers=2,
+                      batch=4) -> str:
+    from jax import export as jexport
+    import jax
+
+    from benchmarks._util import build_step
+    from qfedx_tpu.ops import pallas_body
+
+    for k, v in _TPU_ROUTING.items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.setenv("QFEDX_FUSE", "1")
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "1")
+    monkeypatch.setenv("QFEDX_PALLAS", pallas_pin)
+    # jax.export lowers for the *target* platform; interpret mode would
+    # substitute the traced emulation, so force the Mosaic path.
+    monkeypatch.setattr(pallas_body, "_interpret_default", lambda: False)
+    fn, params, _ = build_step(n, layers, batch, steps=1)
+    return jexport.export(jax.jit(fn), platforms=["tpu"])(params).mlir_module()
+
+
+def test_pallas_route_below_scanned_census_tpu(monkeypatch):
+    """The kernel must EARN its place: the pallas route's TPU-lowered
+    census at n=12 sits strictly below the r17 scanned census, the body
+    occupies exactly two kernel slots (forward lives in the step's fwd
+    and bwd residual passes; the adjoint sweep is the second), and the
+    scan machinery shrinks (3 while loops -> 1: only the optimizer-step
+    scan survives — the carry-copy/xs-slice loops around the body are
+    gone)."""
+    pallas_txt = _tpu_lowered_text(monkeypatch, "1")
+    scanned_txt = _tpu_lowered_text(monkeypatch, "0")
+    pallas = count_state_ops(pallas_txt, 1 << 12)
+    scanned = count_state_ops(scanned_txt, 1 << 12)
+    assert (
+        0 < pallas["lowered_state_ops"] < scanned["lowered_state_ops"]
+    ), (
+        f"pallas route no longer beats the scanned census: "
+        f"pallas={pallas['lowered_state_ops']} "
+        f"scanned={scanned['lowered_state_ops']}"
+    )
+    assert scanned_txt.count("tpu_custom_call") == 0
+    assert pallas_txt.count("tpu_custom_call") == 2, (
+        "the super-layer body must lower as exactly two kernel launches "
+        "(forward + adjoint); more means the body leaked back into "
+        "per-op lowering, fewer means a route fell off the kernel"
+    )
+    assert (
+        pallas_txt.count("stablehlo.while")
+        < scanned_txt.count("stablehlo.while")
+    ), "pallas route kept the scan carry machinery it exists to erase"

@@ -1,0 +1,490 @@
+"""Pallas scan-body kernel (ops/pallas_body.py, r19) parity + routing.
+
+Tier-1 is CPU-only, so correctness rides ``pallas_call`` interpret mode
+(the kernel spec pins ``interpret=True`` off-TPU) at the same altitudes
+as tests/test_scan_layers.py:
+
+- pin: QFEDX_PALLAS grammar (loud on bad values), the fuse→scan→pallas
+  gating chain, and ``route_ok``'s per-program shape gates — a False
+  anywhere is the r17 lax.scan program unchanged, pinned by lowered-
+  text IDENTITY (``=0`` ≡ unset, bit-for-bit);
+- kinds: every kernel emission (lane/rowmat/mask/glane/growmat/rowperm/
+  rowpair + all four CNOT placements) ≡ the scanned route's
+  ``_exec_stacked`` executors on a directly-constructed program,
+  logits AND coefficient gradients, dense and batched/grouped;
+- model: QFEDX_PALLAS=1 ≡ =0 logits AND gradients for the HEA model on
+  the batched engine and the client-folded path (f32 ≤ 2e-5, bf16
+  rounding-bounded), circuit-level Kraus noise stays a scan barrier,
+  and the serving cache keys on the pin (a flip compiles a SECOND
+  route, never serves the stale program);
+- chip: a slow-marked smoke asserting the zero-compiles-in-the-loop
+  serving contract under the kernel route (skipped off-TPU — the
+  on-chip half of the r19 evidence, BENCH_r06+).
+
+All tests pin the TPU production formulation (flip gate form + matmul
+lanes) so the kernel sees the real slab programs on the CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qfedx_tpu.circuits import ansatz
+from qfedx_tpu.ops import fuse
+from qfedx_tpu.ops import pallas_body as pb
+from qfedx_tpu.ops.cpx import CArray, from_complex
+
+N = 10  # smallest slab width
+R = 1 << (N - 7)
+
+
+@pytest.fixture
+def tpu_form(monkeypatch):
+    monkeypatch.setenv("QFEDX_GATE_FORM", "flip")
+    monkeypatch.setenv("QFEDX_SLAB_LANES", "matmul")
+    monkeypatch.setenv("QFEDX_FUSE", "1")
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "1")
+
+
+def _rand_state(n: int, seed: int = 0) -> CArray:
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(2,) * n) + 1j * rng.normal(size=(2,) * n)
+    return from_complex(x / np.linalg.norm(x))
+
+
+def _rand_state_b(n: int, b: int, seed: int = 0) -> CArray:
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(b, 1 << n)) + 1j * rng.normal(size=(b, 1 << n))
+    x = x / np.linalg.norm(x, axis=1, keepdims=True)
+    return CArray(
+        jnp.asarray(x.real, jnp.float32), jnp.asarray(x.imag, jnp.float32)
+    )
+
+
+def _stacks(n, n_layers, seed=0):
+    rng = np.random.default_rng(seed)
+    rx = jnp.asarray(rng.uniform(-2, 2, (n_layers, n)), dtype=jnp.float32)
+    rz = jnp.asarray(rng.uniform(-2, 2, (n_layers, n)), dtype=jnp.float32)
+    return rx, rz
+
+
+def _model(monkeypatch, encoding, n_layers=2, noise_model=None):
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    monkeypatch.setenv("QFEDX_BATCHED", "1")
+    return make_vqc_classifier(
+        n_qubits=N,
+        n_layers=n_layers,
+        num_classes=2,
+        encoding=encoding,
+        noise_model=noise_model,
+    )
+
+
+# --- the pin and the gating chain -------------------------------------------
+
+
+def test_pallas_pin_rejects_invalid(monkeypatch):
+    monkeypatch.setenv("QFEDX_PALLAS", "banana")
+    with pytest.raises(ValueError, match="QFEDX_PALLAS"):
+        pb.pallas_enabled()
+
+
+@pytest.mark.parametrize(
+    "pin,expect", [("1", True), ("on", True), ("0", False), ("off", False)]
+)
+def test_pallas_pin_values(monkeypatch, pin, expect):
+    monkeypatch.setenv("QFEDX_PALLAS", pin)
+    assert pb.pallas_enabled() is expect
+
+
+def test_resolved_route_chain(monkeypatch):
+    """The fuse→scan→pallas chain: each stage conjoined with the one
+    below it — pallas can never report engaged without the scan route,
+    nor scan without fuse (the kernel is built ON the stacked
+    programs)."""
+    monkeypatch.setenv("QFEDX_FUSE", "1")
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "1")
+    monkeypatch.setenv("QFEDX_PALLAS", "1")
+    assert pb.resolved_route() == {
+        "fuse": True, "scan_layers": True, "pallas": True,
+    }
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "0")
+    route = pb.resolved_route()
+    assert route["scan_layers"] is False and route["pallas"] is False
+    monkeypatch.setenv("QFEDX_SCAN_LAYERS", "1")
+    monkeypatch.setenv("QFEDX_FUSE", "0")
+    assert pb.resolved_route() == {
+        "fuse": False, "scan_layers": False, "pallas": False,
+    }
+
+
+def _lane_body(n_layers=2, groups=None, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (n_layers,) + (() if groups is None else (groups,)) + (128, 128)
+    c = CArray(
+        jnp.asarray(rng.normal(size=shape), jnp.float32),
+        jnp.asarray(rng.normal(size=shape), jnp.float32),
+    )
+    return fuse.ScanProgram(
+        (), (fuse.StackedOp("lane", (), c, True),), n_layers
+    )
+
+
+def test_route_ok_gates(monkeypatch, tpu_form):
+    monkeypatch.setenv("QFEDX_PALLAS", "1")
+    state = _rand_state(N)
+    prog = _lane_body()
+    assert pb.route_ok(state, N, prog, batched=False) is True
+    # pin off / below the slab: the r17 program unchanged
+    monkeypatch.setenv("QFEDX_PALLAS", "0")
+    assert pb.route_ok(state, N, prog, batched=False) is False
+    monkeypatch.setenv("QFEDX_PALLAS", "1")
+    assert pb.route_ok(_rand_state(8), 8, prog, batched=False) is False
+    # body kinds the kernel does not emit degrade, never break
+    g1 = fuse.ScanProgram(
+        (),
+        (fuse.StackedOp(
+            "g1", (0,),
+            CArray(jnp.zeros((2, 2, 2)), None), True,
+        ),),
+        2,
+    )
+    assert pb.route_ok(state, N, g1, batched=False) is False
+    # grouped coefficients must divide the state-block grid (G | B)
+    bstate = _rand_state_b(N, 4)
+    assert pb.route_ok(bstate, N, _lane_body(groups=2), True) is True
+    assert pb.route_ok(bstate, N, _lane_body(groups=3), True) is False
+
+
+def test_pallas_off_never_enters_kernel(monkeypatch, tpu_form):
+    """QFEDX_PALLAS=0 (and unset, off-TPU) reproduces the r17 route
+    bit-for-bit: the kernel entry is never called."""
+
+    def boom(*a, **k):  # pragma: no cover - failure mode
+        raise AssertionError("apply_scan_pallas called with pallas off")
+
+    monkeypatch.setattr(pb, "apply_scan_pallas", boom)
+    rx, rz = _stacks(N, 3)
+    state = _rand_state(N)
+    monkeypatch.setenv("QFEDX_PALLAS", "0")
+    ansatz.hardware_efficient(state, {"rx": rx, "rz": rz})
+    monkeypatch.delenv("QFEDX_PALLAS")
+    ansatz.hardware_efficient(state, {"rx": rx, "rz": rz})
+
+
+def test_pallas_off_lowered_text_identity(monkeypatch, tpu_form):
+    """The =0 contract is IDENTITY, not parity: the lowered text of the
+    scanned step with QFEDX_PALLAS=0 equals the unset lowering
+    byte-for-byte, and =1 produces a different program (the kernel
+    call)."""
+    rx, rz = _stacks(N, 3)
+    state = _rand_state(N)
+
+    def lowered():
+        def fn(rx, rz):
+            out = ansatz.hardware_efficient(state, {"rx": rx, "rz": rz})
+            return out.re
+        return jax.jit(fn).lower(rx, rz).as_text()
+
+    monkeypatch.delenv("QFEDX_PALLAS", raising=False)
+    unset = lowered()
+    monkeypatch.setenv("QFEDX_PALLAS", "0")
+    assert lowered() == unset
+    monkeypatch.setenv("QFEDX_PALLAS", "1")
+    assert lowered() != unset
+
+
+# --- kernel kinds vs the scanned executors ----------------------------------
+
+
+def _kind_program(n_layers, groups=None, seed=11):
+    """A stacked program exercising EVERY kernel emission — including
+    the backend-gated kinds (rowperm, growmat) the CPU fusion pass
+    never produces — with conditioned coefficients so f32 parity holds
+    at absolute tolerance."""
+    rng = np.random.default_rng(seed)
+    g = () if groups is None else (groups,)
+
+    def unitary(shape):
+        # Haar-ish unitaries per leading index: the production coeffs
+        # are unitary, so the state norm stays 1 and absolute parity
+        # tolerances mean what they say.
+        d = shape[-1]
+        lead = shape[:-2]
+        z = rng.normal(size=lead + (d, d)) + 1j * rng.normal(
+            size=lead + (d, d)
+        )
+        q, r = np.linalg.qr(z)
+        q = q * (np.diagonal(r, axis1=-2, axis2=-1)
+                 / np.abs(np.diagonal(r, axis1=-2, axis2=-1)))[..., None, :]
+        return CArray(
+            jnp.asarray(q.real, jnp.float32), jnp.asarray(q.imag, jnp.float32)
+        )
+
+    def phases(shape):
+        th = rng.uniform(-np.pi, np.pi, size=shape)
+        return CArray(
+            jnp.asarray(np.cos(th), jnp.float32),
+            jnp.asarray(np.sin(th), jnp.float32),
+        )
+
+    L = n_layers
+    perm = rng.permutation(R)
+    body = (
+        fuse.StackedOp("lane", (), unitary((L,) + g + (128, 128)), True),
+        fuse.StackedOp("mask", (), phases((L,) + g + (1 << N,)), True),
+        fuse.StackedOp("growmat", (8,), unitary((L,) + g + (2, R, R)), True),
+        fuse.StackedOp(
+            "rowpair", (0, 2),
+            jax.tree.map(
+                lambda x: x.reshape(x.shape[:-2] + (2, 2, 2, 2)),
+                unitary((L,) + g + (4, 4)),
+            ),
+            True,
+        ),
+        fuse.StackedOp("rowperm", (), perm, False),
+        fuse.StackedOp("glane", (1,), unitary((L,) + g + (2, 128, 128)), True),
+        fuse.StackedOp("rowmat", (), unitary((L,) + g + (R, R)), True),
+        fuse.StackedOp("cnot", (0, 1), None, False),   # row-row
+        fuse.StackedOp("cnot", (5, 8), None, False),   # lane-lane
+        fuse.StackedOp("cnot", (2, 9), None, False),   # row ctrl, lane tgt
+        fuse.StackedOp("cnot", (9, 2), None, False),   # lane ctrl, row tgt
+    )
+    return fuse.ScanProgram((), body, L)
+
+
+def _coeff_tree(program):
+    return tuple(op.coeffs for op in program.body if op.stacked)
+
+
+def _with_coeffs(program, coeffs):
+    it = iter(coeffs)
+    body = tuple(
+        op._replace(coeffs=next(it)) if op.stacked else op
+        for op in program.body
+    )
+    return program._replace(body=body)
+
+
+@pytest.mark.parametrize("batched,groups", [
+    # The dense arm of this matrix is covered by
+    # test_dense_engine_parity_and_grads (same _emit per kind — only
+    # the packing differs, and the HEA test drives dense packing);
+    # keeping the kinds torture to the batched arms holds the tier-1
+    # single-core budget.
+    (True, None), (True, 2),
+])
+def test_kernel_kinds_parity_and_grads(monkeypatch, tpu_form,
+                                       batched, groups):
+    """Every kernel emission ≡ ``_exec_stacked``: one program through
+    ``fuse.apply_scan`` under both pin values, outputs and coefficient
+    COTANGENTS compared — the custom_vjp's adjoint-kernel state pass
+    and the vjp-of-the-layer-body coefficient contraction both pinned
+    against lax.scan's autodiff."""
+    L = 3
+    program = _kind_program(L, groups=groups)
+    state = _rand_state_b(N, 4, seed=5) if batched else _rand_state(N, 5)
+    rng = np.random.default_rng(6)
+    w = jnp.asarray(
+        rng.normal(size=(1 << N,)), jnp.float32
+    ).reshape((1 << N,) if batched else (2,) * N)
+    coeffs = _coeff_tree(program)
+
+    def fwd(coeffs):
+        out = fuse.apply_scan(
+            state, N, _with_coeffs(program, coeffs), batched=batched
+        )
+        return out.re, out.im
+
+    def loss(coeffs):
+        re, im = fwd(coeffs)
+        return jnp.sum(w * (re**2 + im**2))
+
+    monkeypatch.setenv("QFEDX_PALLAS", "0")
+    f0 = jax.tree.leaves(jax.jit(fwd)(coeffs))
+    g0 = jax.tree.leaves(jax.jit(jax.grad(loss))(coeffs))
+    monkeypatch.setenv("QFEDX_PALLAS", "1")
+    f1 = jax.tree.leaves(jax.jit(fwd)(coeffs))
+    g1 = jax.tree.leaves(jax.jit(jax.grad(loss))(coeffs))
+    for a, b in zip(f0, f1):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=0
+        )
+    for a, b in zip(g0, g1):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=0
+        )
+
+
+# --- model-level parity (the tier-1 acceptance matrix) ----------------------
+
+
+def test_dense_engine_parity_and_grads(monkeypatch, tpu_form):
+    """Dense engine: HEA logits and angle gradients, pallas vs scanned
+    (the natural CPU fusion body — rowmat + glane + wrap CNOT)."""
+    rx, rz = _stacks(N, 3, seed=7)
+    state = _rand_state(N, 3)
+    rng = np.random.default_rng(8)
+    w = jnp.asarray(rng.normal(size=(2,) * N), jnp.float32)
+
+    def loss(rx, rz):
+        out = ansatz.hardware_efficient(state, {"rx": rx, "rz": rz})
+        return jnp.sum(w * (out.re**2 + out.im**2))
+
+    monkeypatch.setenv("QFEDX_PALLAS", "0")
+    l0 = jax.jit(loss)(rx, rz)
+    g0 = jax.jit(jax.grad(loss, argnums=(0, 1)))(rx, rz)
+    monkeypatch.setenv("QFEDX_PALLAS", "1")
+    l1 = jax.jit(loss)(rx, rz)
+    g1 = jax.jit(jax.grad(loss, argnums=(0, 1)))(rx, rz)
+    np.testing.assert_allclose(
+        np.asarray(l0), np.asarray(l1), atol=2e-5, rtol=0
+    )
+    for a, b in zip(g0, g1):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=0
+        )
+
+
+def test_model_pallas_parity(monkeypatch, tpu_form):
+    """Batched engine + client-folded path: QFEDX_PALLAS=1 ≡ =0 logits
+    AND gradients through the real classifier (the same acceptance
+    matrix r17 pinned for the scan route)."""
+    import optax
+
+    m = _model(monkeypatch, "angle")
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.uniform(0, 1, (2, N)), dtype=jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, (2,)), dtype=jnp.int32)
+    params = m.init(jax.random.PRNGKey(0))
+
+    monkeypatch.setenv("QFEDX_PALLAS", "1")
+    a = m.apply(params, x)
+    monkeypatch.setenv("QFEDX_PALLAS", "0")
+    b = m.apply(params, x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5, rtol=0)
+
+    def loss(p):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            m.apply(p, x), y
+        ).mean()
+
+    monkeypatch.setenv("QFEDX_PALLAS", "1")
+    g1 = jax.grad(loss)(params)
+    monkeypatch.setenv("QFEDX_PALLAS", "0")
+    g0 = jax.grad(loss)(params)
+    for u, v in zip(jax.tree.leaves(g1), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(
+            np.asarray(u), np.asarray(v), atol=2e-5, rtol=0
+        )
+
+    # client-folded path: per-client stacks become kernel coeff GROUPS
+    cparams = jax.tree.map(
+        lambda p: p[None]
+        * (1.0 + 0.1 * jnp.arange(2).reshape((2,) + (1,) * p.ndim)),
+        params,
+    )
+    cx = jnp.stack([x, x * 0.9])
+    monkeypatch.setenv("QFEDX_PALLAS", "1")
+    fa = m.apply_clients(cparams, cx)
+    monkeypatch.setenv("QFEDX_PALLAS", "0")
+    fb = m.apply_clients(cparams, cx)
+    np.testing.assert_allclose(
+        np.asarray(fa), np.asarray(fb), atol=2e-5, rtol=0
+    )
+
+
+def test_model_pallas_parity_bf16(monkeypatch, tpu_form):
+    monkeypatch.setenv("QFEDX_DTYPE", "bf16")
+    m = _model(monkeypatch, "angle")
+    rng = np.random.default_rng(10)
+    x = jnp.asarray(rng.uniform(0, 1, (2, N)), dtype=jnp.float32)
+    params = m.init(jax.random.PRNGKey(1))
+    monkeypatch.setenv("QFEDX_PALLAS", "1")
+    a = np.asarray(m.apply(params, x))
+    monkeypatch.setenv("QFEDX_PALLAS", "0")
+    b = np.asarray(m.apply(params, x))
+    assert np.all(np.isfinite(a))
+    np.testing.assert_allclose(a, b, atol=3e-2, rtol=0)
+
+
+def test_noise_channels_stay_barriers(monkeypatch, tpu_form):
+    """Circuit-level Kraus noise keeps the per-layer loop — a channel
+    between layers is a scan barrier, so the kernel route (like the
+    scan route before it) never sees it and trajectories coincide
+    sample-for-sample on the SAME PRNG stream."""
+    from qfedx_tpu.noise import NoiseModel
+
+    nm = NoiseModel(depolarizing_p=0.1, circuit_level=True)
+    m = _model(monkeypatch, "angle", n_layers=2, noise_model=nm)
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.uniform(0, 1, (2, N)), dtype=jnp.float32)
+    params = m.init(jax.random.PRNGKey(2))
+    key = jax.random.PRNGKey(3)
+    monkeypatch.setenv("QFEDX_PALLAS", "1")
+    a = np.asarray(m.apply_train(params, x, key))
+    monkeypatch.setenv("QFEDX_PALLAS", "0")
+    b = np.asarray(m.apply_train(params, x, key))
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=0)
+
+
+def test_persistent_forward_routes_on_pallas_pin(monkeypatch, tpu_form):
+    """The serving cache keys on QFEDX_PALLAS: flipping the pin around
+    one facade compiles a SECOND route instead of serving the stale
+    program (serve/forward.py _ROUTING_PINS)."""
+    from qfedx_tpu.serve.forward import cached_routes, persistent_forward
+
+    m = _model(monkeypatch, "angle")
+    params = m.init(jax.random.PRNGKey(4))
+    x = jnp.zeros((2, N), dtype=jnp.float32)
+    fwd = persistent_forward(m.apply)
+    monkeypatch.setenv("QFEDX_PALLAS", "1")
+    fwd(params, x)
+    assert cached_routes(m.apply) == 1
+    monkeypatch.setenv("QFEDX_PALLAS", "0")
+    fwd(params, x)
+    assert cached_routes(m.apply) == 2
+
+
+# --- on-chip smoke (the BENCH_r06+ half of the r19 evidence) ----------------
+
+
+@pytest.mark.slow
+def test_serve_zero_compiles_under_kernel_route_on_chip(monkeypatch):
+    """On the chip the kernel is the DEFAULT serving route; the r14
+    zero-compiles-in-the-loop contract must hold under it — warmup
+    absorbs the Mosaic compile, the loop re-dispatches the cached
+    kernel program."""
+    if jax.default_backend() != "tpu":
+        pytest.skip("on-chip smoke: requires a TPU backend")
+    from qfedx_tpu import obs
+    from qfedx_tpu.serve.engine import ServeEngine
+    from qfedx_tpu.serve.forward import persistent_forward
+
+    monkeypatch.setenv("QFEDX_TRACE", "1")
+    monkeypatch.setenv("QFEDX_PALLAS", "1")
+    obs.reset()
+    m = _model(monkeypatch, "angle")
+    params = m.init(jax.random.PRNGKey(5))
+    engine = ServeEngine(
+        persistent_forward(m.apply), params, n_features=N, buckets=(1, 4)
+    )
+    warm = engine.warmup()
+    assert warm["route_resolved"]["pallas"] is True
+
+    def compile_total():
+        return sum(
+            v for k, v in obs.registry().counters.items()
+            if k.startswith("compile.")
+        )
+
+    at_warmup = compile_total()
+    assert at_warmup > 0
+    rng = np.random.default_rng(12)
+    for _ in range(8):
+        engine.infer(jnp.asarray(
+            rng.uniform(0, 1, (3, N)), dtype=jnp.float32
+        ))
+    assert compile_total() == at_warmup
