@@ -50,6 +50,13 @@ Sections in ``bench_details.json`` (beyond the headline):
   pipeline row; ``trace_overhead_vs_off`` is the measured end-to-end
   cost of enabled tracing (PERF.md §13 pins only the disabled-span
   microcost), ``vs_prev``-tracked.
+- ``fed16q_bf16_watch_on``: the r20 detection lever — the trainer-path
+  row under QFEDX_WATCH=1 (one rule sweep per tick + bounded
+  instruments recording, trace off), head-to-head vs the identical
+  watch-off pipeline row; ``watch_overhead_vs_off`` is the measured
+  end-to-end cost of always-on detection. ``alerts_fired`` on this row
+  and the serve row is the quiet-run canary (expected 0; any firing —
+  or any increase vs prev — is ``vs_prev``-flagged as a regression).
 - ``fault_tolerance``: accuracy under injected client churn — the
   dropout_rate → accuracy degradation curve at 0/5/20% casualties per
   round (half drops, half NaN updates; utils/faults), streamed trainer;
@@ -1023,6 +1030,16 @@ def _bench_serve(jax, n_qubits=16, n_layers=3, requests_per_rate=384):
             }
         compile_in_loop = compile_s() - compile_before
 
+        # r20 detection canary: the sweep ran under the live watchdog
+        # (QFEDX_WATCH in the section wrapper — warmup starts the
+        # ticker). A closing evaluation flushes the last window; any
+        # firing lands in alerts_fired. Expected 0 on-chip: a breach
+        # during bench IS a regression signal, tracked by vs_prev.
+        from qfedx_tpu.obs import watch as _watch
+
+        _watch.evaluate_once()
+        alert_totals = _watch.fired_totals()
+
         ok = [
             r for r in rates.values()
             if r.get("p95_ms") is not None
@@ -1056,12 +1073,21 @@ def _bench_serve(jax, n_qubits=16, n_layers=3, requests_per_rate=384):
             "throughput_at_slo": best["completed_rps"] if best else 0.0,
             "serve_p50_ms": best["p50_ms"] if best else None,
             "serve_p95_ms": best["p95_ms"] if best else None,
+            "alerts_fired": int(sum(alert_totals.values())),
+            "alerts_by_rule": alert_totals or None,
         }
 
     # QFEDX_TRACE on for the whole section: the compile listener is the
     # zero-compile measurement; span overhead is µs against ms batches
-    # (docs/PERF.md §13).
-    return _with_env({"QFEDX_TRACE": "1"}, run)
+    # (docs/PERF.md §13). QFEDX_WATCH on (r20): the watchdog ticks over
+    # the live sweep — the alerts_fired canary above.
+    from qfedx_tpu.obs import watch as _watch
+
+    _watch.reset()
+    try:
+        return _with_env({"QFEDX_TRACE": "1", "QFEDX_WATCH": "1"}, run)
+    finally:
+        _watch.reset()
 
 
 def _bench_fusion_hlo(jax):
@@ -1655,6 +1681,47 @@ def main():
             / fed16_bf16_trace_on["client_rounds_per_s"],
             3,
         )
+
+    # The r20 watchdog lever: the SAME trainer-path row with
+    # QFEDX_WATCH=1 and trace OFF — what always-on detection costs
+    # END-TO-END (bounded instruments recording + one rule sweep per
+    # tick), head-to-head against fed16q_bf16_pipeline. The closing
+    # evaluation flushes the last window; alerts_fired is the quiet-run
+    # canary (expected 0 — a healthy trainer fires nothing).
+    def _fed16q_watched(j):
+        from qfedx_tpu.obs import watch as _watch
+
+        _watch.reset()
+        try:
+
+            def run_watched():
+                _watch.evaluate_once()  # baseline tick for delta rules
+                out = _bench_fed16q_pipeline(j)
+                _watch.evaluate_once()
+                return out
+
+            out = _with_env(
+                {"QFEDX_DTYPE": "bf16", "QFEDX_PIPELINE": "1",
+                 "QFEDX_WATCH": "1"},
+                run_watched,
+            )
+            totals = _watch.fired_totals()
+            out["alerts_fired"] = int(sum(totals.values()))
+            out["alerts_by_rule"] = totals or None
+        finally:
+            _watch.reset()
+        return out
+
+    fed16_bf16_watch_on = safe(_fed16q_watched)
+    if (
+        "client_rounds_per_s" in fed16_bf16_watch_on
+        and "client_rounds_per_s" in fed16_bf16_pipeline
+    ):
+        fed16_bf16_watch_on["watch_overhead_vs_off"] = round(
+            fed16_bf16_pipeline["client_rounds_per_s"]
+            / fed16_bf16_watch_on["client_rounds_per_s"],
+            3,
+        )
     fed256 = safe(_bench_fed256)
     # r10: cohort size unbound from HBM — 4096 clients/round through
     # 256-client streamed waves on one chip (hierarchical partial/apply
@@ -1781,6 +1848,31 @@ def main():
                 ),
                 True,
             )
+            # The r20 watchdog lever, same first-appearance rule.
+            delta(
+                "fed16q_watch_on_client_rounds_per_s",
+                fed16_bf16_watch_on.get("client_rounds_per_s"),
+                (prev.get("fed16q_bf16_watch_on") or {}).get(
+                    "client_rounds_per_s"
+                ),
+                True,
+            )
+            # alerts_fired canaries: expected 0 on BOTH sides, so the
+            # ratio-based delta() (which skips prev == 0) cannot track
+            # them — any increase regresses outright.
+            for cname, now_a, prev_a in (
+                ("serve_alerts_fired", serve.get("alerts_fired"),
+                 (prev.get("serve") or {}).get("alerts_fired")),
+                ("fed16q_watch_on_alerts_fired",
+                 fed16_bf16_watch_on.get("alerts_fired"),
+                 (prev.get("fed16q_bf16_watch_on") or {}).get(
+                     "alerts_fired")),
+            ):
+                if now_a is not None and prev_a is not None:
+                    vs_prev[cname] = {
+                        "prev": prev_a, "now": now_a,
+                        "regressed": bool(now_a > prev_a),
+                    }
             # NOTE: r15 changed the serve quantile definition to
             # histogram lower-edge (see _bench_serve) — the first
             # vs_prev across that boundary carries a <= one-bucket
@@ -1908,6 +2000,10 @@ def main():
         "metric": "vqc_client_rounds_per_sec_per_chip",
         "value": round(value, 3),
         "unit": "client-rounds/s/chip",
+        # Provenance (r20): `qfedx bench history` must never trend a
+        # CPU-container number against an on-chip one — the explicit
+        # field beats the round-watermark inference.
+        "backend": jax.default_backend(),
         "value_blocks": value_blocks,
         "timing_methodology": "chained+fetch-anchored; median over >=3 blocks (r04+)",
         "vs_baseline": round(value / baseline_value, 3),
@@ -1933,6 +2029,7 @@ def main():
         "fed16q_bf16_pipeline_off": fed16_bf16_pipeline_off,
         "fed16q_bf16_guards_off": fed16_bf16_guards_off,
         "fed16q_bf16_trace_on": fed16_bf16_trace_on,
+        "fed16q_bf16_watch_on": fed16_bf16_watch_on,
         "fed256": fed256,
         "fed_streamed": fed_streamed,
         "fault_tolerance": fault_tolerance,
@@ -1974,6 +2071,7 @@ def main():
                 "metric": "vqc_client_rounds_per_sec_per_chip",
                 "value": round(value, 3),
                 "unit": "client-rounds/s/chip",
+                "backend": jax.default_backend(),
                 "vs_baseline": round(value / baseline_value, 3),
                 "value_blocks": value_blocks,
                 "rounds_per_call": scan_k,
@@ -2019,6 +2117,22 @@ def main():
                     # bench_details.json trace_overhead_vs_off).
                     "bf16_trainer_trace_on": fed16_bf16_trace_on.get(
                         "client_rounds_per_s"
+                    ),
+                    # r20: the same trainer path with QFEDX_WATCH=1 —
+                    # the measured end-to-end cost of always-on
+                    # detection (compare bf16_trainer_pipeline; ratio
+                    # in bench_details.json watch_overhead_vs_off).
+                    "bf16_trainer_watch_on": fed16_bf16_watch_on.get(
+                        "client_rounds_per_s"
+                    ),
+                },
+                # r20 canaries: watchdog firings during the watched
+                # rows — expected 0; any breach is a regression signal
+                # (vs_prev tracks both).
+                "alerts_fired": {
+                    "serve": serve.get("alerts_fired"),
+                    "fed16q_watch_on": fed16_bf16_watch_on.get(
+                        "alerts_fired"
                     ),
                 },
                 "fed256": {

@@ -1,0 +1,44 @@
+"""Lint: obs/watch alert rules match the documented taxonomy.
+
+Thin wrapper (the check_pins/check_spans pattern): the single
+definition lives on the unified analysis engine —
+``qfedx_tpu.analysis.rules_doc`` (rule **QFX106** under ``qfedx
+lint``; docs/ANALYSIS.md has the taxonomy). The contract: every rule
+ID in ``obs/watch.RULES`` has a row in docs/OBSERVABILITY.md's
+"## Alert-rule taxonomy" table, every row names a live rule, and each
+row's threshold-pin cell names the pin the rule actually reads — the
+operator paged by a ``qfedx_alert_*`` gauge looks the ID up in exactly
+one place, which must not lie about the retuning knob.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from qfedx_tpu.analysis.rules_doc import (  # noqa: E402,F401
+    check_alerts,
+    documented_alert_rules,
+)
+
+
+def main() -> int:
+    problems = check_alerts()
+    if problems:
+        print("alert-rule taxonomy drift (docs/OBSERVABILITY.md):")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print(
+        f"ok: {len(documented_alert_rules())} alert rules, obs/watch.py "
+        "and docs/OBSERVABILITY.md table agree"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

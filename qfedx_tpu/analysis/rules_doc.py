@@ -272,3 +272,100 @@ register(Rule(
     "profile_summary.json schema table agree (both directions)",
     _run_profile_schema,
 ))
+
+
+# -- QFX106 (alert-rule taxonomy) ----------------------------------------------
+#
+# The watchdog's detection contract (r20): every rule ID in
+# obs/watch.RULES needs a row in docs/OBSERVABILITY.md's "## Alert-rule
+# taxonomy" table, every row must name a live rule, and each row's
+# threshold-pin cell must name the pin the rule actually reads — an
+# operator paged by ``qfedx_alert_serve.shed_rate`` looks the ID up in
+# exactly one place, and that place must not lie about which knob
+# retunes it.
+
+ALERT_DOC = "docs/OBSERVABILITY.md"
+_ALERT_HEADING = "## Alert-rule taxonomy"
+_ALERT_ROW = re.compile(r"^\|\s*`([a-z0-9_.]+)`")
+
+
+def documented_alert_rules(
+    doc_path: str | Path | None = None,
+) -> dict[str, str]:
+    """``{rule_id: threshold_pin_cell}`` parsed from the alert-rule
+    taxonomy table (columns: rule ID | signal | threshold pin |
+    fires on)."""
+    path = Path(doc_path) if doc_path else _default_repo_root() / ALERT_DOC
+    out: dict[str, str] = {}
+    in_section = False
+    for line in path.read_text().splitlines():
+        stripped = line.strip()
+        if stripped.startswith("#"):
+            in_section = stripped.startswith(_ALERT_HEADING)
+            continue
+        if not in_section or not _ALERT_ROW.match(stripped):
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if len(cells) >= 3:
+            ticked = _TICKED.findall(cells[2])
+            out[cells[0].strip("`")] = ticked[0] if ticked else ""
+    return out
+
+
+def check_alerts(doc_path: str | Path | None = None) -> list[str]:
+    """Problem strings (empty = clean) — the standalone surface
+    benchmarks/check_alerts.py and tests/test_check_pins.py share."""
+    from qfedx_tpu.obs.watch import rule_taxonomy
+
+    code = rule_taxonomy()
+    doc = documented_alert_rules(doc_path)
+    problems = []
+    for rid, spec in sorted(code.items()):
+        if rid not in doc:
+            problems.append(
+                f"alert rule {rid} (obs/watch.py) has no row in the "
+                "docs/OBSERVABILITY.md alert-rule taxonomy table"
+            )
+        elif doc[rid] != spec["threshold_pin"]:
+            problems.append(
+                f"alert rule {rid}: taxonomy row names threshold pin "
+                f"{doc[rid]!r}, obs/watch.py reads "
+                f"{spec['threshold_pin']!r}"
+            )
+    for rid in sorted(set(doc) - set(code)):
+        problems.append(
+            f"alert-rule taxonomy row {rid} matches no rule in "
+            "obs/watch.py (stale doc row?)"
+        )
+    return problems
+
+
+def _run_alert_taxonomy(ctx: LintContext) -> list[Finding]:
+    doc = ctx.doc(ALERT_DOC)
+    if not doc.exists():
+        return [Finding(
+            "QFX106", ALERT_DOC, 1,
+            f"{ALERT_DOC} is missing — it carries the alert-rule "
+            "taxonomy table (the watchdog's operator contract)",
+        )]
+    try:
+        problems = check_alerts(doc)
+    except Exception as exc:  # noqa: BLE001 — a moved surface is a finding
+        return [Finding(
+            "QFX106", ALERT_DOC, 1,
+            f"alert-taxonomy source unavailable: {exc}",
+        )]
+    rows = _section_rows(doc, _ALERT_HEADING, _ALERT_ROW, skip="rule ID")
+    out = []
+    for p in problems:
+        line = next((ln for rid, ln in rows.items() if rid in p), 1)
+        out.append(Finding("QFX106", ALERT_DOC, line, p))
+    return out
+
+
+register(Rule(
+    "QFX106", "alert-taxonomy",
+    "obs/watch alert rules and the docs/OBSERVABILITY.md alert-rule "
+    "taxonomy table agree — IDs both directions, threshold pins exact",
+    _run_alert_taxonomy,
+))

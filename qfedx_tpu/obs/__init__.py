@@ -12,7 +12,12 @@ a LIVE half: bounded log-bucketed histograms (``obs.Histogram`` /
 (``obs.write_trace_shard`` / ``obs.merge_trace_shards``). Since r16 it
 also has a DEVICE half: crash-safe profiler captures and a parsed
 device-timeline census (``obs.profile`` — measured op counts, inter-op
-gap histograms, per-span device attribution; ``QFEDX_PROFILE``).
+gap histograms, per-span device attribution; ``QFEDX_PROFILE``). Since
+r20 it has a DETECTION half: an SLO watchdog evaluating stable-ID'd
+alert rules on a ticker (``obs.watch``; ``QFEDX_WATCH`` — firing rules
+surface on /metrics, /healthz and metrics.jsonl) and an always-on
+flight recorder dumping a bounded black-box ``flight.json`` on SIGTERM,
+crash or alert (``obs.flight``; ``QFEDX_FLIGHT``).
 
 Usage::
 
@@ -29,7 +34,7 @@ instruments also record while a live /metrics endpoint is up
 (trace.metrics_enabled).
 """
 
-from qfedx_tpu.obs import profile
+from qfedx_tpu.obs import flight, profile, watch
 from qfedx_tpu.obs.export import (
     chrome_trace_events,
     percentile,
@@ -71,6 +76,7 @@ __all__ = [
     "counter",
     "enabled",
     "find_shards",
+    "flight",
     "gauge",
     "histogram",
     "lowered_state_ops",
@@ -88,6 +94,7 @@ __all__ = [
     "snapshot",
     "span",
     "trace_context",
+    "watch",
     "write_chrome_trace",
     "write_trace_shard",
     "xla_annotations_enabled",

@@ -42,7 +42,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
-from qfedx_tpu.obs import trace
+from qfedx_tpu.obs import flight, trace
 from qfedx_tpu.utils import pins
 
 _lock = threading.Lock()
@@ -98,14 +98,40 @@ def render_prometheus() -> str:
     return "\n".join(lines) + "\n"
 
 
+def health_components() -> dict:
+    """Run every registered health source once and return the component
+    dict; a raising source contributes ``{"error": ...}`` instead of
+    killing the caller. Shared by /healthz rendering and the r20
+    watchdog's snapshot (obs/watch.py), which must read components
+    WITHOUT the alerts section — alerts are derived from this, not
+    input to it."""
+    with _lock:
+        sources = dict(_health_sources)
+    comps = {}
+    for name, fn in sorted(sources.items()):
+        try:
+            comps[name] = fn()
+        except Exception as exc:  # noqa: BLE001 — a sick source degrades, never 500s
+            comps[name] = {"error": f"{type(exc).__name__}: {exc}"}
+    return comps
+
+
+# Last status health_payload computed — the flight recorder logs the
+# ok→degraded→ok EDGES (a ring of identical "ok" rows is noise).
+_last_status = "ok"
+
+
 def health_payload() -> dict:
     """The /healthz body: per-component sources merged under one status.
     A raising source marks the payload degraded but never kills the
-    probe — an orchestrator must be able to read a sick process."""
+    probe — an orchestrator must be able to read a sick process. When
+    the watchdog (obs/watch.py) is enabled the payload carries an
+    ``alerts`` section, and any FIRING rule drives the same
+    degraded→503 path — the probe names the rule, not just the mood."""
+    from qfedx_tpu.obs import watch
     from qfedx_tpu.run.metrics import METRICS_SCHEMA_VERSION
 
     with _lock:
-        sources = dict(_health_sources)
         srv = _server
     out: dict = {
         "status": "ok",
@@ -114,14 +140,23 @@ def health_payload() -> dict:
     }
     if srv is not None:
         out["uptime_s"] = round(time.monotonic() - srv.started_mono, 3)
-    comps = {}
-    for name, fn in sorted(sources.items()):
-        try:
-            comps[name] = fn()
-        except Exception as exc:  # noqa: BLE001 — a sick source degrades, never 500s
-            comps[name] = {"error": f"{type(exc).__name__}: {exc}"}
+    comps = health_components()
+    for comp in comps.values():
+        if isinstance(comp, dict) and "error" in comp:
             out["status"] = "degraded"
     out["components"] = comps
+    if watch.enabled():
+        active = watch.active_alerts()
+        out["alerts"] = {
+            "active": active,
+            "fired_total": watch.fired_totals(),
+        }
+        if active:
+            out["status"] = "degraded"
+    global _last_status
+    if out["status"] != _last_status:
+        flight.on_health(out["status"], _last_status)
+        _last_status = out["status"]
     return out
 
 
@@ -154,7 +189,7 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *_a):  # noqa: D102
         return None
 
-    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+    def _respond(self, send_body: bool) -> None:
         path = self.path.split("?", 1)[0]
         # The span closes BEFORE the response bytes go out: a client
         # that has received its reply must be able to see the request's
@@ -179,7 +214,35 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
-        self.wfile.write(body)
+        if send_body:
+            self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        self._respond(send_body=True)
+
+    def do_HEAD(self):  # noqa: N802 — orchestrator probes (curl -I,
+        # k8s httpGet with a HEAD-preferring proxy) must get real
+        # status codes + Content-Length without the body bytes.
+        self._respond(send_body=False)
+
+
+class _TelemetryHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose per-request error hook does not dump
+    tracebacks to stderr. socketserver's default handle_error PRINTS —
+    a bare print by another name (the QFX105 discipline) — and a client
+    disconnecting mid-scrape (BrokenPipeError/ConnectionResetError:
+    curl timeouts, probe cancellations) is routine under load, not an
+    error. Disconnects bump a counter; anything else degrades to a
+    counter too, keeping stderr clean for the actual workload."""
+
+    def handle_error(self, request, client_address):  # noqa: D102
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+            trace.counter("obs.http_client_disconnects")
+            return
+        trace.counter("obs.http_handler_errors")
 
 
 class TelemetryServer:
@@ -188,7 +251,7 @@ class TelemetryServer:
     def __init__(self, port: int):
         # localhost only: telemetry is an operator loopback/sidecar
         # surface, not a public listener.
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd = _TelemetryHTTPServer(("127.0.0.1", port), _Handler)
         self._httpd.daemon_threads = True
         self.port = int(self._httpd.server_address[1])
         self.started_mono = time.monotonic()

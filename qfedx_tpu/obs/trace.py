@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Any
 
+from qfedx_tpu.obs import flight
 from qfedx_tpu.obs.histo import Histogram
 from qfedx_tpu.utils import pins
 
@@ -68,9 +69,14 @@ def set_live_metrics(on: bool) -> None:
 
 def metrics_enabled() -> bool:
     """Should counters/gauges/histograms record? True when QFEDX_TRACE
-    is on OR a live /metrics endpoint is serving (bounded state only —
-    see set_live_metrics)."""
-    return _live_metrics or enabled()
+    is on, OR a live /metrics endpoint is serving, OR the r20 watchdog
+    is enabled (bounded state only — a watchdog evaluating an empty
+    registry would be blind; see set_live_metrics / obs.watch)."""
+    if _live_metrics or enabled():
+        return True
+    from qfedx_tpu.obs import watch
+
+    return watch.enabled()
 
 
 def xla_annotations_enabled() -> bool:
@@ -365,6 +371,7 @@ class span:
             except Exception:  # noqa: BLE001
                 pass
         reg.add_span(sp)
+        flight.on_span(sp.name, sp.duration)
         return False
 
 
@@ -401,16 +408,20 @@ class trace_context:
 
 def counter(name: str, inc: float = 1.0) -> None:
     """Accumulate a process-total counter (no-op when tracing is off
-    and no live /metrics endpoint is running)."""
+    and no live /metrics endpoint is running). Mirrored into the flight
+    ring when QFEDX_FLIGHT is on — bounded, independent of the gate."""
     if metrics_enabled():
         _REGISTRY.add_counter(name, float(inc))
+    flight.on_counter(name, inc)
 
 
 def gauge(name: str, value: float) -> None:
     """Record the latest value of a quantity (no-op when tracing is off
-    and no live /metrics endpoint is running)."""
+    and no live /metrics endpoint is running). Mirrored into the flight
+    ring when QFEDX_FLIGHT is on."""
     if metrics_enabled():
         _REGISTRY.set_gauge(name, float(value))
+    flight.on_gauge(name, value)
 
 
 def histogram(name: str, value: float) -> None:
@@ -418,9 +429,11 @@ def histogram(name: str, value: float) -> None:
     (obs/histo.py — fixed memory, merge-able, ~10% quantile error).
     The registry instrument behind the /metrics bucket rendering and
     the serve latency quantiles. No-op when tracing is off and no live
-    /metrics endpoint is running."""
+    /metrics endpoint is running. Mirrored into the flight ring when
+    QFEDX_FLIGHT is on."""
     if metrics_enabled():
         _REGISTRY.record_histogram(name, float(value))
+    flight.on_histogram(name, value)
 
 
 def record_device_memory(prefix: str = "mem") -> dict | None:

@@ -214,6 +214,25 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--seeds", type=int, default=3)
     s.add_argument("--run-root", default="runs")
 
+    b = sub.add_parser(
+        "bench",
+        help="bench-trajectory tools over the committed BENCH_r*.json "
+             "ledger",
+    )
+    bsub = b.add_subparsers(dest="bench_cmd", required=True)
+    bh = bsub.add_parser(
+        "history",
+        help="parse the BENCH_r*.json trajectory (numeric sort, "
+             "methodology-era tagging, on-chip-vs-CPU provenance) into "
+             "per-metric trend verdicts; exit 1 on a regression",
+    )
+    bh.add_argument("--dir", default=".",
+                    help="directory holding BENCH_r*.json (default: cwd)")
+    bh.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report only (one JSON object)")
+    bh.add_argument("--no-gate", action="store_true",
+                    help="report but always exit 0 (advisory mode)")
+
     lnt = sub.add_parser(
         "lint",
         help="AST static analysis: trace-purity, pin discipline, span/"
@@ -564,8 +583,12 @@ def run_serve(args) -> dict:
     # SIGTERM lands as KeyboardInterrupt on the main thread (the same
     # hardened translation the streamed trainer uses — utils/host): the
     # finally-drain answers every admitted request before exit.
+    from qfedx_tpu.obs import flight
     from qfedx_tpu.utils.host import install_sigterm_interrupt, restore_sigterm
 
+    # Black-box wiring (r20): when QFEDX_FLIGHT is on, the ring of
+    # recent events lands next to the serve outputs on SIGTERM.
+    flight.set_dump_path(Path(args.run_dir) / "flight.json")
     sigterm_token = install_sigterm_interrupt()
     batcher = MicroBatcher(engine).start()
     responses = 0
@@ -603,6 +626,7 @@ def run_serve(args) -> dict:
             responses += 1
     except KeyboardInterrupt:
         say("[qfedx_tpu] interrupted — draining in-flight requests")
+        flight.maybe_dump(reason="sigterm")
     finally:
         batcher.close(drain=True)
         while window:  # answered by the drain; emit in order
@@ -643,6 +667,222 @@ def run_serve(args) -> dict:
     return summary
 
 
+# -- the bench-trajectory regression ledger (r20) ------------------------------
+#
+# bench.py compares one run against ONE previous snapshot (vs_prev);
+# nothing reads the committed BENCH_r*.json TRAJECTORY — so "BENCH_r05
+# is still the latest on-chip snapshot" lives as a ROADMAP footnote
+# instead of a machine-checkable fact. `qfedx bench history` parses the
+# whole ledger into per-metric trend verdicts with a gate-able exit
+# code. Pure stdlib file parsing: no backend, no heavy imports (the
+# same early-dispatch discipline as `qfedx lint`).
+
+# Mirrors bench.py's _FIRST_COMPARABLE_ROUND: r01–r03 predate the r04
+# timing-methodology fix (block-median walls), so their numbers are
+# tagged and EXCLUDED from trend verdicts rather than compared.
+_FIRST_COMPARABLE_BENCH_ROUND = 4
+# Provenance watermark (ROADMAP "Open items"): rounds ≤ this ran in the
+# on-chip TPU container; later rounds ran in CPU containers and must
+# never be trend-compared against chip numbers. Rows that carry an
+# explicit "backend" field (bench.py records one since r20) win over
+# this inference.
+_LAST_ONCHIP_BENCH_ROUND = 5
+
+# (dotted path into the parsed compact row, higher_is_better)
+_BENCH_TREND_METRICS = (
+    ("value", True),
+    ("per_dispatch_value", True),
+    ("fed16q_client_rounds_per_s.bf16", True),
+    ("engine_fwd_grad_ms.n18", False),
+    ("time_to_target.seconds", False),
+)
+
+
+def _dig(obj, dotted):
+    for part in dotted.split("."):
+        if not isinstance(obj, dict) or part not in obj:
+            return None
+        obj = obj[part]
+    return obj
+
+
+def _bench_history_rows(bench_dir) -> list[dict]:
+    """Parse every BENCH_r*.json in ``bench_dir``, numerically sorted,
+    each row tagged with methodology era and on-chip-vs-CPU provenance.
+    A null ``parsed`` is recovered from the captured ``tail`` (the r04
+    row predates the parser fix — bench.py's own recovery rule)."""
+    import re
+
+    rows = []
+    for path in Path(bench_dir).glob("BENCH_r*.json"):
+        m = re.search(r"BENCH_r(\d+)\.json$", path.name)
+        if not m:
+            continue
+        n = int(m.group(1))
+        row = {"round": n, "file": path.name}
+        try:
+            rec = json.loads(path.read_text())
+        except ValueError:
+            row.update(parseable=False, error="bad JSON")
+            rows.append(row)
+            continue
+        parsed = rec.get("parsed")
+        recovered = False
+        if not isinstance(parsed, dict):
+            tail = rec.get("tail") or ""
+            at = tail.find('{"metric"')
+            if at >= 0:
+                try:
+                    parsed, _end = json.JSONDecoder().raw_decode(tail[at:])
+                    recovered = isinstance(parsed, dict)
+                except ValueError:
+                    parsed = None
+            if not isinstance(parsed, dict):
+                parsed = None
+        backend = parsed.get("backend") if parsed else None
+        row.update(
+            rc=rec.get("rc"),
+            parseable=parsed is not None,
+            recovered_from_tail=recovered,
+            methodology=(
+                "pre-r04" if n < _FIRST_COMPARABLE_BENCH_ROUND else "r04+"
+            ),
+            provenance=backend or (
+                "tpu" if n <= _LAST_ONCHIP_BENCH_ROUND else "cpu"
+            ),
+            parsed=parsed,
+        )
+        rows.append(row)
+    rows.sort(key=lambda r: r["round"])
+    return rows
+
+
+def _bench_trends(rows) -> tuple[dict, list[str]]:
+    """Per-metric trend verdicts over the comparable rows (r04+
+    methodology), comparing the latest point against the most recent
+    EARLIER point of the SAME provenance — a CPU-container number must
+    never read as a regression against an on-chip one. Thresholds
+    mirror bench.py's vs_prev (±5%)."""
+    verdicts: dict = {}
+    regressed: list[str] = []
+    comparable = [
+        r for r in rows if r.get("parseable") and r["methodology"] == "r04+"
+    ]
+    for key, higher_better in _BENCH_TREND_METRICS:
+        series = []
+        for r in comparable:
+            v = _dig(r["parsed"], key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                series.append((r["round"], r["provenance"], float(v)))
+        if len(series) < 2:
+            verdicts[key] = {"verdict": "n/a", "points": len(series)}
+            continue
+        last = series[-1]
+        prev = next(
+            (s for s in reversed(series[:-1]) if s[1] == last[1]), None
+        )
+        if prev is None:
+            verdicts[key] = {
+                "verdict": "no-prior-same-provenance",
+                "now_round": last[0],
+                "provenance": last[1],
+            }
+            continue
+        if prev[2] == 0:
+            verdicts[key] = {"verdict": "n/a", "points": len(series)}
+            continue
+        ratio = last[2] / prev[2]
+        if higher_better:
+            verdict = (
+                "regressed" if ratio < 0.95
+                else ("improved" if ratio > 1.05 else "flat")
+            )
+        else:
+            verdict = (
+                "regressed" if ratio > 1.05
+                else ("improved" if ratio < 0.95 else "flat")
+            )
+        verdicts[key] = {
+            "verdict": verdict,
+            "prev_round": prev[0],
+            "now_round": last[0],
+            "prev": prev[2],
+            "now": last[2],
+            "ratio": round(ratio, 4),
+            "provenance": last[1],
+        }
+        if verdict == "regressed":
+            regressed.append(key)
+    return verdicts, regressed
+
+
+def _bench_history_compact(bench_dir) -> dict | None:
+    """One-line ledger summary, or None when ``bench_dir`` holds no
+    BENCH files — what `qfedx inspect` attaches when a run dir sits
+    next to the committed trajectory."""
+    rows = _bench_history_rows(bench_dir)
+    if not rows:
+        return None
+    _verdicts, regressed = _bench_trends(rows)
+    return {
+        "dir": str(bench_dir),
+        "rounds": len(rows),
+        "latest": rows[-1]["round"],
+        "latest_on_chip": max(
+            (r["round"] for r in rows if r.get("provenance") == "tpu"),
+            default=None,
+        ),
+        "regressed": regressed,
+    }
+
+
+def run_bench_history(args) -> int:
+    """``qfedx bench history``: the regression ledger. Exit 0 = no
+    trend regression, 1 = regression (gate-able; ``--no-gate`` keeps
+    it advisory), 2 = no BENCH files found."""
+    from qfedx_tpu.utils.host import is_primary
+
+    say = print if is_primary() else (lambda *a, **k: None)
+    bench_dir = Path(args.dir)
+    rows = _bench_history_rows(bench_dir)
+    if not rows:
+        say(f"[qfedx_tpu] no BENCH_r*.json files under {bench_dir}")
+        return 2
+    verdicts, regressed = _bench_trends(rows)
+    report = {
+        "dir": str(bench_dir),
+        "rows": [
+            {k: v for k, v in r.items() if k != "parsed"} for r in rows
+        ],
+        "verdicts": verdicts,
+        "regressed": regressed,
+        "latest_on_chip": max(
+            (r["round"] for r in rows if r.get("provenance") == "tpu"),
+            default=None,
+        ),
+    }
+    if args.as_json:
+        say(json.dumps(report))
+    else:
+        for r in rows:
+            tags = [r.get("methodology", "?"), r.get("provenance", "?")]
+            if not r.get("parseable"):
+                tags.append("unparseable")
+            elif r.get("recovered_from_tail"):
+                tags.append("tail-recovered")
+            val = _dig(r.get("parsed") or {}, "value")
+            say(f"[qfedx_tpu] r{r['round']:02d} {r['file']}: "
+                f"value={val} [{', '.join(tags)}]")
+        for key, v in verdicts.items():
+            say(f"[qfedx_tpu] {key}: {json.dumps(v)}")
+        say("[qfedx_tpu] " + json.dumps(report))
+        if regressed and not args.no_gate:
+            say("[qfedx_tpu] REGRESSED: " + ", ".join(regressed))
+    if regressed and not args.no_gate:
+        return 1
+    return 0
+
+
 def run_inspect(run_dir) -> dict:
     """``qfedx inspect <run-dir>``: the read side of the run directory.
 
@@ -681,6 +921,11 @@ def run_inspect(run_dir) -> dict:
             if isinstance(rec.get("round"), int):
                 rows.append(rec)
 
+    # Event rows (r20 watchdog alerts) interleave with round rows in
+    # the same file, keyed by "event" instead of "round" — every
+    # round-shaped aggregate below must see round rows ONLY.
+    event_rows = [r for r in rows if "event" in r]
+    rows = [r for r in rows if "event" not in r]
     accs = [r["accuracy"] for r in rows if r.get("accuracy") is not None]
     losses = [r["loss"] for r in rows if r.get("loss") is not None]
     # The permanent robustness record (r11–r13 ledgers) — summed only
@@ -693,10 +938,19 @@ def run_inspect(run_dir) -> dict:
         )
         if any(field in r for r in rows)
     }
+    # The detection record: firing transitions per rule ID, from the
+    # structured alert events the watchdog sank into this file.
+    alerts_fired: dict[str, int] = {}
+    for r in event_rows:
+        if r.get("event") == "alert" and r.get("state") == "firing":
+            rid = str(r.get("rule", "?"))
+            alerts_fired[rid] = alerts_fired.get(rid, 0) + 1
     out = {
         "run_dir": str(run_dir),
         "rounds_completed": max((r["round"] for r in rows), default=0),
         "metrics_rows": len(rows),
+        "event_rows": len(event_rows),
+        "alerts_fired": alerts_fired,
         "invalid_rows": len(invalid),
         "first_accuracy": accs[0] if accs else None,
         "best_accuracy": max(accs) if accs else None,
@@ -757,6 +1011,32 @@ def run_inspect(run_dir) -> dict:
                     f"n={model.get('n_qubits', '?')} "
                     f"layers={model.get('n_layers', '?')}"
                 )
+    # The black box (r20): a flight.json left by a SIGTERM'd/crashed or
+    # alert-firing process. Summarized, never re-dumped — inspect is the
+    # read side.
+    flight_path = run_dir / "flight.json"
+    if flight_path.exists():
+        try:
+            fl = json.loads(flight_path.read_text())
+        except ValueError:
+            bad_artifacts.append("flight.json")
+        else:
+            out["flight"] = {
+                "path": str(flight_path),
+                "bytes": flight_path.stat().st_size,
+                "reason": fl.get("reason"),
+                "events": len(fl.get("events", [])),
+                "dropped": fl.get("dropped"),
+            }
+    # Bench-trajectory adjacency: when this run dir sits inside (or
+    # next to) a checkout carrying the committed BENCH_r*.json ledger,
+    # attach the compact history row so one inspect answers both "how
+    # did this run do" and "where is the trajectory".
+    for cand in (run_dir, run_dir.parent, run_dir.parent.parent):
+        compact = _bench_history_compact(cand)
+        if compact is not None:
+            out["bench_history"] = compact
+            break
     if bad_artifacts:
         out["unreadable_artifacts"] = bad_artifacts
     say(f"[qfedx_tpu] {run_dir}: {out['rounds_completed']} rounds, "
@@ -764,6 +1044,16 @@ def run_inspect(run_dir) -> dict:
         f"(best {out['best_accuracy']})")
     if ledger:
         say("[qfedx_tpu] ledger: " + json.dumps(ledger))
+    if alerts_fired:
+        say("[qfedx_tpu] alerts fired: " + json.dumps(alerts_fired))
+    if "flight" in out:
+        say(f"[qfedx_tpu] flight recorder: {out['flight']['path']} "
+            f"({out['flight']['bytes']} bytes, "
+            f"reason={out['flight']['reason']}, "
+            f"{out['flight']['events']} events)")
+    if "bench_history" in out:
+        say("[qfedx_tpu] bench history: "
+            + json.dumps(out["bench_history"]))
     say("[qfedx_tpu] route: " + json.dumps(out["route"]))
     if "floor_attribution" in out:
         say("[qfedx_tpu] floor: " + json.dumps(out["floor_attribution"]))
@@ -821,6 +1111,10 @@ def main(argv=None):
         # No compile cache, no backend, no heavy imports: lint is a
         # pure AST pass, seconds not minutes (docs/ANALYSIS.md).
         raise SystemExit(run_lint_cmd(args))
+    if args.cmd == "bench":
+        # Same early-dispatch discipline: the regression ledger is pure
+        # file parsing over committed BENCH_r*.json snapshots.
+        raise SystemExit(run_bench_history(args))
     # Persistent XLA compilation cache (QFEDX_COMPILE_CACHE; default on —
     # shared definition with bench.py in qfedx_tpu.utils.cache). Enabled
     # before dispatching ANY subcommand: train pays one cold n=18 slab

@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 import time
 from pathlib import Path
 from typing import Any, Mapping
@@ -37,14 +38,27 @@ _REQUIRED_FIELDS: dict[str, Any] = {
     "ts": lambda v: isinstance(v, (int, float)),
 }
 
+# Event rows (r20): the watchdog's structured alert records interleave
+# with round rows in the SAME file, keyed by an "event" field instead
+# of "round" — still schema 1 (round rows are unchanged; consumers that
+# filter on "round" never see these).
+_EVENT_REQUIRED_FIELDS: dict[str, Any] = {
+    "schema": lambda v: v == METRICS_SCHEMA_VERSION,
+    "event": lambda v: isinstance(v, str) and bool(v),
+    "ts": lambda v: isinstance(v, (int, float)),
+}
+
 
 def validate_metrics_record(rec: Mapping[str, Any]) -> dict:
     """Validate one parsed metrics.jsonl record against the schema;
     returns the record, raises ``ValueError`` naming the offending
-    field. The round-trip test (tests/test_run_io.py) runs every
-    logged row back through this, so the file and the live endpoint
-    can never silently disagree on field names."""
-    for name, ok in _REQUIRED_FIELDS.items():
+    field. Rows carrying an ``"event"`` field validate as event rows
+    (watchdog alerts), everything else as round rows. The round-trip
+    test (tests/test_run_io.py) runs every logged row back through
+    this, so the file and the live endpoint can never silently disagree
+    on field names."""
+    required = _EVENT_REQUIRED_FIELDS if "event" in rec else _REQUIRED_FIELDS
+    for name, ok in required.items():
         if name not in rec:
             raise ValueError(
                 f"metrics record missing required field {name!r} "
@@ -126,6 +140,11 @@ class MetricsLogger:
     def __init__(self, path: str | Path):
         self.path = Path(path)
         self._fh = None
+        # Since r20 the watchdog ticker thread appends alert-event rows
+        # while the training thread appends round rows — interleaved
+        # writes to one fd must stay whole-line (the crash-safety claim
+        # is per-LINE durability, not per-thread).
+        self._write_lock = threading.Lock()
         if is_primary():
             self.path.parent.mkdir(parents=True, exist_ok=True)
             self._fh = open(self.path, "a")
@@ -136,13 +155,18 @@ class MetricsLogger:
         rec = dict(_jsonable(record))
         rec.setdefault("ts", time.time())
         rec.setdefault("schema", METRICS_SCHEMA_VERSION)
-        self._fh.write(json.dumps(rec) + "\n")
-        self._fh.flush()
-        os.fsync(self._fh.fileno())
+        line = json.dumps(rec) + "\n"
+        with self._write_lock:
+            if self._fh.closed:
+                return
+            self._fh.write(line)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
 
     def close(self) -> None:
         if self._fh is not None:
-            self._fh.close()
+            with self._write_lock:
+                self._fh.close()
 
     def __enter__(self):
         return self
@@ -174,9 +198,30 @@ class ExperimentRun:
                 )
         self.metrics = MetricsLogger(self.dir / "metrics.jsonl")
         self._t0 = time.time()
+        # r20 detection wiring: the flight recorder's black box lands in
+        # THIS run's directory, and watchdog alerts land in THIS run's
+        # metrics.jsonl as structured event rows. Both are no-ops unless
+        # their pins (QFEDX_FLIGHT / QFEDX_WATCH) are on; the sink is
+        # identity-matched on __exit__ so a nested/later run wins.
+        from qfedx_tpu.obs import flight, watch
+
+        flight.set_dump_path(self.dir / "flight.json")
+        self._alert_sink = self.metrics.log
+        watch.set_event_sink(self._alert_sink)
 
     def on_round_end(self, round_idx: int, metrics: Mapping[str, Any]) -> None:
         self.metrics.log({"round": round_idx + 1, **metrics})
+        # Mirror the round edge into the flight ring (bounded, no-op
+        # with QFEDX_FLIGHT off): a trainer path that records no other
+        # telemetry still leaves its last rounds in the black box.
+        from qfedx_tpu.obs import flight
+
+        flight.record(
+            "round",
+            f"r{round_idx + 1}",
+            loss=metrics.get("loss"),
+            accuracy=metrics.get("accuracy"),
+        )
 
     def checkpointer(self, every: int = 5, keep: int = 3):
         from qfedx_tpu.run.checkpoint import Checkpointer
@@ -243,9 +288,35 @@ class ExperimentRun:
             pass
 
     def __enter__(self):
+        # Every tracked run drains on an orchestrator's TERM exactly
+        # like a Ctrl-C (the utils/host translation) so ``__exit__``
+        # actually runs: a raw SIGTERM skips the whole unwind and
+        # leaves no flight.json, no trace flush, no closed metrics —
+        # precisely on the runs that most need forensics. The streamed
+        # trainer and ``qfedx serve`` install their own copy on top;
+        # nesting is safe because each restores what it found.
+        from qfedx_tpu.obs import flight
+        from qfedx_tpu.utils.host import install_sigterm_interrupt
+
+        self._sigterm_token = install_sigterm_interrupt()
+        flight.record("lifecycle", "run.start", dir=str(self.dir))
         return self
 
     def __exit__(self, exc_type, exc, tb):
+        from qfedx_tpu.obs import flight, watch
+        from qfedx_tpu.utils.host import restore_sigterm
+
+        restore_sigterm(getattr(self, "_sigterm_token", None))
+        watch.clear_event_sink(only_if=self._alert_sink)
+        if exc_type is not None:
+            # The black box dumps on ANY unwinding exception — including
+            # the KeyboardInterrupt("SIGTERM") translation from
+            # utils/host — and unlike the trace flush below it does NOT
+            # require QFEDX_TRACE: flight is the record of the default-
+            # pins process that died.
+            flight.maybe_dump(
+                reason=getattr(exc_type, "__name__", str(exc_type))
+            )
         self.metrics.close()
         if exc_type is not None:
             self.flush_partial_observability(

@@ -992,9 +992,19 @@ def train_federated_streamed(
     # probes: last COMPLETED round and the age of the last metrics
     # flush — a wedged wave shows as a growing flush age long before
     # any log line would.
+    from qfedx_tpu.obs import flight, watch
     from qfedx_tpu.obs import server as obs_server
 
     obs_server.maybe_start()
+    # r20 detection: the watchdog ticker rides the trainer's heartbeat
+    # (trainer.stall reads last_flush_age_s from the health source
+    # below) and the loss/epsilon gauges the loop records; the flight
+    # ring gets the lifecycle edge. Both default off.
+    watch.maybe_start()
+    flight.record(
+        "lifecycle", "trainer.start",
+        rounds=num_rounds, cohort=cohort_size, waves=num_waves,
+    )
     _beat = {
         "last_completed_round": start_round,
         "rounds_total": num_rounds,
@@ -1436,6 +1446,14 @@ def train_federated_streamed(
             _beat["last_completed_round"] = rnd + 1
             _beat["last_flush_t"] = time.monotonic()
             obs.gauge("fed.last_completed_round", rnd + 1)
+            # The watchdog's divergence signals (trainer.loss fires on
+            # non-finite/over-limit loss, trainer.eps_burn on DP budget
+            # overrun) read these gauges — recorded unconditionally so
+            # a watch-only process (no trace, no endpoint) still sees
+            # them (obs.gauge gates itself).
+            obs.gauge("fed.loss", loss)
+            if "epsilon" in metrics:
+                obs.gauge("fed.epsilon", metrics["epsilon"])
             obs.histogram("round.time_s", dt)
 
             last_done, last_params = rnd + 1, params
@@ -1461,6 +1479,7 @@ def train_federated_streamed(
                 pass
         raise
     finally:
+        flight.record("lifecycle", "trainer.exit", last_done=last_done)
         obs_server.clear_health_source("trainer")
         for p in pending_late:
             try:

@@ -51,6 +51,7 @@ from typing import Any
 import numpy as np
 
 from qfedx_tpu import obs
+from qfedx_tpu.obs import flight, watch
 from qfedx_tpu.obs import server as obs_server
 from qfedx_tpu.utils import faults
 
@@ -136,6 +137,12 @@ class MicroBatcher:
         # unless QFEDX_METRICS_PORT is set. The health source exposes
         # the ledger a /healthz probe needs to call the loop live.
         obs_server.maybe_start()
+        # r20 detection: the watchdog ticker (QFEDX_WATCH, default off)
+        # starts at the same seams the endpoint does, and the flight
+        # ring gets the lifecycle edge.
+        watch.maybe_start()
+        flight.record("lifecycle", "batcher.start",
+                      max_queue=self.config.max_queue)
         # One stable callable per batcher: bound-method attribute access
         # creates a fresh object each time, and close()'s only_if match
         # is by identity.
@@ -151,6 +158,10 @@ class MicroBatcher:
         with self._cond:
             return {
                 "queue_depth": len(self._pending),
+                # The admission ceiling, so queue_depth is readable as a
+                # saturation fraction (the watchdog's serve.queue_sat
+                # rule divides these two).
+                "max_queue": self.config.max_queue,
                 "closed": self._closed,
                 "engine_warm": bool(getattr(self.engine, "_warm", False)),
                 "buckets": list(self.config.buckets),
@@ -175,6 +186,7 @@ class MicroBatcher:
         # batcher's live source.
         if getattr(self, "_health_fn", None) is not None:
             obs_server.clear_health_source("serve", only_if=self._health_fn)
+        flight.record("lifecycle", "batcher.close", drain=drain)
 
     def __enter__(self):
         return self.start()

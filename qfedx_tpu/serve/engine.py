@@ -181,9 +181,16 @@ class ServeEngine:
         (obs/server.py; default off — no thread, no behavior change),
         so even a batcher-less embedder gets a scrape surface the
         moment the engine warms."""
+        from qfedx_tpu.obs import flight, watch
         from qfedx_tpu.obs import server as obs_server
 
         obs_server.maybe_start()
+        # r20 detection: watchdog ticker + flight lifecycle edge at the
+        # same startup seam as the live endpoint (both default off).
+        watch.maybe_start()
+        flight.record(
+            "lifecycle", "engine.warmup", buckets=str(self.config.buckets)
+        )
         per_bucket = {}
         for b in self.config.buckets:
             x = np.zeros((b,) + self.feature_shape, dtype=np.float32)

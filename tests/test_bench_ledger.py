@@ -1,0 +1,143 @@
+"""`qfedx bench history` — the bench-trajectory regression ledger (r20).
+
+Host-side only (no backend, no jit): the ledger parses committed
+BENCH_r*.json files, tags methodology eras (pre-r04 rows are excluded
+from trends) and result provenance (on-chip vs CPU-container numbers
+never cross-compare), and exits 1 on a same-provenance regression so a
+driver can gate on it. Plus the `qfedx inspect` surfacing satellite:
+alert-event totals, the flight dump, and the adjacent bench trajectory
+all ride the one inspect JSON line.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _write_bench(d, n, parsed=None, tail="", rc=0):
+    rec = {"n": n, "cmd": "python bench.py", "rc": rc, "tail": tail,
+           "parsed": parsed}
+    (d / f"BENCH_r{n:02d}.json").write_text(json.dumps(rec))
+
+
+def _history(tmp_path, *extra):
+    from qfedx_tpu.run.cli import build_parser, run_bench_history
+
+    args = build_parser().parse_args(
+        ["bench", "history", "--dir", str(tmp_path), "--json", *extra]
+    )
+    return run_bench_history(args)
+
+
+def test_bench_history_gates_on_seeded_regression(tmp_path, capsys):
+    """The acceptance fixture: a same-provenance regression exits 1
+    while the pre-r04-methodology row and the on-chip-vs-CPU boundary
+    are tagged, not compared."""
+    _write_bench(tmp_path, 2, parsed={"metric": "m", "value": 9999.0})
+    _write_bench(tmp_path, 4, parsed={"metric": "m", "value": 100.0})
+    _write_bench(tmp_path, 5, parsed={"metric": "m", "value": 110.0})
+    _write_bench(
+        tmp_path, 6, parsed={"metric": "m", "value": 50.0, "backend": "cpu"}
+    )
+    _write_bench(
+        tmp_path, 7, parsed={"metric": "m", "value": 40.0, "backend": "cpu"}
+    )
+    rc = _history(tmp_path)
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1
+    assert report["regressed"] == ["value"]
+    by_round = {r["round"]: r for r in report["rows"]}
+    assert by_round[2]["methodology"] == "pre-r04"
+    assert by_round[4]["provenance"] == "tpu"  # watermark inference
+    assert by_round[6]["provenance"] == "cpu"  # explicit backend field
+    v = report["verdicts"]["value"]
+    # r07 vs r06: both cpu — the chip numbers never enter the ratio
+    assert (v["prev_round"], v["now_round"]) == (6, 7)
+    assert v["verdict"] == "regressed" and v["ratio"] == 0.8
+    assert report["latest_on_chip"] == 5
+    # --no-gate keeps the same report advisory
+    assert _history(tmp_path, "--no-gate") == 0
+
+
+def test_bench_history_never_crosses_provenance(tmp_path, capsys):
+    """A CPU container number FAR below the chip number is
+    'no-prior-same-provenance', not a regression."""
+    _write_bench(tmp_path, 4, parsed={"metric": "m", "value": 1000.0})
+    _write_bench(
+        tmp_path, 6, parsed={"metric": "m", "value": 10.0, "backend": "cpu"}
+    )
+    rc = _history(tmp_path)
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert report["verdicts"]["value"]["verdict"] == (
+        "no-prior-same-provenance"
+    )
+
+
+def test_bench_history_recovers_parsed_from_tail(tmp_path, capsys):
+    _write_bench(tmp_path, 4, parsed={"metric": "m", "value": 100.0})
+    _write_bench(
+        tmp_path, 5, parsed=None,
+        tail='noise\n{"metric": "m", "value": 95.0}\ntrailing\n',
+    )
+    rc = _history(tmp_path)
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0  # 0.95 exactly is flat, not regressed
+    by_round = {r["round"]: r for r in report["rows"]}
+    assert by_round[5]["parseable"] and by_round[5]["recovered_from_tail"]
+    assert report["verdicts"]["value"]["verdict"] == "flat"
+
+
+def test_bench_history_empty_dir_exits_2(tmp_path):
+    assert _history(tmp_path) == 2
+
+
+def test_bench_history_numeric_sort_not_lexicographic(tmp_path, capsys):
+    # r10 must sort AFTER r9, not between r1 and r2
+    _write_bench(tmp_path, 9, parsed={"metric": "m", "value": 100.0})
+    _write_bench(tmp_path, 10, parsed={"metric": "m", "value": 50.0,
+                                       "backend": "cpu"})
+    _write_bench(tmp_path, 11, parsed={"metric": "m", "value": 49.0,
+                                       "backend": "cpu"})
+    _history(tmp_path)
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert [r["round"] for r in report["rows"]] == [9, 10, 11]
+
+
+def test_inspect_surfaces_alerts_flight_and_bench(tmp_path, capsys):
+    """The satellite: `qfedx inspect` reports alert-event totals by
+    rule, the flight dump, and the adjacent bench trajectory."""
+    run_dir = tmp_path / "runs" / "r1"
+    run_dir.mkdir(parents=True)
+    rows = [
+        {"schema": 1, "round": 1, "ts": 1.0, "loss": 0.5},
+        {"schema": 1, "event": "alert", "state": "firing",
+         "rule": "serve.shed_rate", "ts": 2.0},
+        {"schema": 1, "event": "alert", "state": "cleared",
+         "rule": "serve.shed_rate", "ts": 3.0},
+        {"schema": 1, "event": "alert", "state": "firing",
+         "rule": "serve.shed_rate", "ts": 4.0},
+        {"schema": 1, "round": 2, "ts": 5.0, "loss": 0.4},
+    ]
+    (run_dir / "metrics.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows)
+    )
+    (run_dir / "flight.json").write_text(json.dumps(
+        {"schema": 1, "reason": "sigterm", "events": [{"t": 1.0}]}
+    ))
+    _write_bench(tmp_path, 4, parsed={"metric": "m", "value": 100.0})
+
+    from qfedx_tpu.run.cli import run_inspect
+
+    out = run_inspect(run_dir)
+    capsys.readouterr()
+    assert out["rounds_completed"] == 2  # event rows never count
+    assert out["alerts_fired"] == {"serve.shed_rate": 2}
+    assert out["event_rows"] == 3
+    assert out["flight"]["reason"] == "sigterm"
+    assert out["flight"]["events"] == 1
+    assert out["bench_history"]["latest"] == 4  # found via parent walk

@@ -183,6 +183,60 @@ def test_profile_schema_guard_fires_both_directions(tmp_path):
     assert "ops_executed" not in documented_fields(doc)
 
 
+# --- the alert-rule taxonomy guard (r20 satellite, same family) --------------
+
+from benchmarks.check_alerts import (  # noqa: E402
+    check_alerts,
+    documented_alert_rules,
+)
+
+
+def test_alert_taxonomy_matches_source():
+    assert check_alerts() == []
+
+
+def test_alert_taxonomy_covers_every_rule():
+    # An empty parse would make the drift check vacuously pass; the
+    # table must carry exactly the append-only RULE_IDS surface, pins
+    # included.
+    from qfedx_tpu.obs.watch import rule_taxonomy
+
+    doc = documented_alert_rules()
+    code = rule_taxonomy()
+    assert set(doc) == set(code)
+    for rid in (
+        "serve.p95_slo", "serve.shed_rate", "serve.queue_sat",
+        "trainer.stall", "trainer.loss", "trainer.eps_burn",
+    ):
+        assert rid in doc, f"taxonomy lost {rid}"
+        assert doc[rid] == code[rid]["threshold_pin"]
+
+
+def test_alert_guard_fires_both_directions(tmp_path):
+    doc = tmp_path / "OBS.md"
+    doc.write_text(
+        "## Alert-rule taxonomy\n\n"
+        "| Rule ID | Signal | Threshold pin | Fires on |\n"
+        "|---|---|---|---|\n"
+        "| `serve.p95_slo` | p95 | `QFEDX_SERVE_SLO_MS` | breach |\n"
+        "| `serve.shed_rate` | sheds | `QFEDX_WRONG_PIN` | sheds |\n"
+        "| `made.up_rule` | nothing | `QFEDX_WATCH_SHED` | never |\n"
+    )
+    problems = check_alerts(doc)
+    # missing rules, a wrong-pin cell, and the stale row all fire
+    assert any("trainer.stall" in p for p in problems)
+    assert any(
+        "serve.shed_rate" in p and "QFEDX_WRONG_PIN" in p for p in problems
+    )
+    assert any("made.up_rule" in p and "stale" in p for p in problems)
+    assert not any("serve.p95_slo" in p for p in problems)
+    # rows outside the section are not taxonomy rows
+    doc.write_text(
+        "## Some other table\n\n| id |\n|---|\n| `serve.p95_slo` |\n"
+    )
+    assert "serve.p95_slo" not in documented_alert_rules(doc)
+
+
 def test_fault_guard_fires_both_directions(tmp_path):
     doc = tmp_path / "ROB.md"
     doc.write_text(

@@ -1,0 +1,389 @@
+"""The r20 detection layer: SLO watchdog, flight recorder, bench ledger.
+
+Three contracts pinned here:
+
+1. **Active detection end-to-end** — a live-scraped `/healthz` flips
+   200 → 503 while an injected serve fault drives a watchdog rule over
+   threshold, NAMES the firing rule in the payload, and recovers to 200
+   when the fault clears; the alert counts reconcile EXACTLY against
+   the FaultPlan's deterministic replay (the same oracle discipline the
+   serve ledger tests use).
+2. **The black box** — a SIGTERM'd run (the in-process utils/host
+   translation, the test_stream idiom) leaves a parseable,
+   size-bounded `flight.json` behind with default pins otherwise.
+3. **Default-off invariance** — with the pins unset there is no ticker
+   thread, no ring, no file, and `evaluate_once` is a `[]` no-op.
+
+(The `qfedx bench history` regression-ledger tests live in
+tests/test_bench_ledger.py — pure host-side, no backend.)
+"""
+
+import json
+import os
+import signal as signal_mod
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from qfedx_tpu import obs
+from qfedx_tpu.obs import flight, watch
+from qfedx_tpu.obs import server as obs_server
+from qfedx_tpu.serve.batcher import MicroBatcher, RequestError
+from qfedx_tpu.serve.engine import ServeConfig, ServeEngine
+from qfedx_tpu.utils.faults import FaultPlan
+
+
+@pytest.fixture(autouse=True)
+def _clean_detection_state():
+    obs_server.stop_server()  # a failed test must not leak its server
+    obs.reset()
+    watch.reset()
+    flight.reset()
+    yield
+    obs_server.stop_server()
+    watch.reset()
+    flight.reset()
+    obs.reset()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return r.status, r.read().decode()
+
+
+def _engine(buckets=(2,), max_queue=8):
+    import jax
+
+    from qfedx_tpu.models.vqc import make_vqc_classifier
+
+    model = make_vqc_classifier(n_qubits=4, n_layers=1, num_classes=2)
+    params = model.init(jax.random.PRNGKey(0))
+    cfg = ServeConfig(
+        buckets=buckets, deadline_ms=50.0, max_queue=max_queue
+    )
+    return ServeEngine(model, params, (4,), config=cfg)
+
+
+def _rows(m, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((m, 4)).astype(np.float32)
+
+
+# --- the tentpole: live 200 -> 503 -> 200 with an exact fault oracle ----------
+
+
+def test_healthz_flips_on_injected_fault_and_recovers(
+    monkeypatch, tmp_path
+):
+    """The acceptance path: watchdog on, serve fault injected, the live
+    probe degrades naming `serve.shed_rate`, recovery restores 200, and
+    every count reconciles against the FaultPlan replay."""
+    plan_spec = {"seed": 3, "rules": [
+        {"site": "serve.request", "kind": "nan", "rounds": [1, 3]},
+    ]}
+    monkeypatch.setenv("QFEDX_FAULTS", json.dumps(plan_spec))
+    monkeypatch.setenv("QFEDX_WATCH", "1")
+
+    from qfedx_tpu.run.metrics import ExperimentRun, validate_metrics_record
+
+    srv = obs_server.start_server(0)
+    engine = _engine(buckets=(2,))
+    engine.warmup()
+    try:
+        with ExperimentRun(tmp_path, name="watchrun") as run:
+            with MicroBatcher(engine) as b:
+                assert watch.evaluate_once() == []  # baseline tick
+                status, body = _get(srv.port, "/healthz")
+                assert status == 200
+                assert json.loads(body)["alerts"]["active"] == []
+
+                rows = _rows(5)
+                rejected = 0
+                for i in range(5):
+                    try:
+                        b.submit(rows[i]).result(timeout=30)
+                    except RequestError:
+                        rejected += 1
+
+                active = watch.evaluate_once()  # the detection tick
+                assert [a["rule"] for a in active] == ["serve.shed_rate"]
+                with pytest.raises(urllib.error.HTTPError) as exc_info:
+                    _get(srv.port, "/healthz")
+                assert exc_info.value.code == 503
+                hz = json.loads(exc_info.value.read())
+                assert hz["status"] == "degraded"
+                assert [a["rule"] for a in hz["alerts"]["active"]] == [
+                    "serve.shed_rate"
+                ]
+
+                active = watch.evaluate_once()  # quiet tick: delta 0
+                assert active == []
+                status, body = _get(srv.port, "/healthz")
+                assert status == 200
+                hz = json.loads(body)
+                assert hz["status"] == "ok"
+                assert hz["alerts"]["fired_total"] == {
+                    "serve.shed_rate": 1
+                }
+
+        # The exact oracle: replay the SAME plan spec on a fresh
+        # instance — the deterministic mutation schedule IS the
+        # expected rejection ledger, not a >= smell test.
+        replay = FaultPlan(**plan_spec)
+        expected = sum(
+            1 for seq in range(5)
+            if replay.request_mutation(seq) is not None
+        )
+        assert expected == 2  # the fixture itself stays honest
+        assert rejected == expected == b.stats["rejected"]
+        reg = obs.registry()
+        assert reg.counters["serve.requests_rejected"] == expected
+        assert reg.counters["alert.fired.serve.shed_rate"] == 1
+        assert reg.gauges["alert.serve.shed_rate"] == 0.0  # cleared
+
+        # ...and the structured event rows landed in metrics.jsonl,
+        # schema-valid, firing value == the replayed count.
+        rows_logged = [
+            validate_metrics_record(json.loads(line))
+            for line in (run.dir / "metrics.jsonl").read_text().splitlines()
+            if line.strip()
+        ]
+        alerts = [r for r in rows_logged if r.get("event") == "alert"]
+        assert [(a["state"], a["rule"]) for a in alerts] == [
+            ("firing", "serve.shed_rate"),
+            ("cleared", "serve.shed_rate"),
+        ]
+        assert alerts[0]["value"] == float(expected)
+    finally:
+        obs_server.stop_server()
+
+
+def test_trainer_stall_rule_fires_on_flush_age(monkeypatch):
+    """The wedged-wave detector: a trainer health source reporting a
+    stale last_flush_age_s trips `trainer.stall`; a fresh flush clears
+    it."""
+    monkeypatch.setenv("QFEDX_WATCH", "on")
+    monkeypatch.setenv("QFEDX_WATCH_STALL_S", "60")
+    age = {"v": 5.0}
+    obs_server.set_health_source(
+        "trainer", lambda: {"last_flush_age_s": age["v"]}
+    )
+    try:
+        assert watch.evaluate_once() == []
+        age["v"] = 120.0
+        active = watch.evaluate_once()
+        assert [a["rule"] for a in active] == ["trainer.stall"]
+        assert active[0]["threshold"] == 60.0
+        age["v"] = 1.0
+        assert watch.evaluate_once() == []
+        assert watch.fired_totals() == {"trainer.stall": 1}
+    finally:
+        obs_server.clear_health_source("trainer")
+
+
+def test_loss_rule_nonfinite_always_fires(monkeypatch):
+    monkeypatch.setenv("QFEDX_WATCH", "1")
+    obs.gauge("fed.loss", 0.42)
+    assert watch.evaluate_once() == []
+    obs.gauge("fed.loss", float("nan"))
+    active = watch.evaluate_once()
+    assert [a["rule"] for a in active] == ["trainer.loss"]
+    obs.gauge("fed.loss", 0.40)
+    assert watch.evaluate_once() == []
+
+
+def test_eps_burn_rule_gates_on_budget(monkeypatch):
+    monkeypatch.setenv("QFEDX_WATCH", "1")
+    obs.gauge("fed.epsilon", 7.5)
+    assert watch.evaluate_once() == []  # inf budget by default
+    monkeypatch.setenv("QFEDX_WATCH_EPS", "5.0")
+    active = watch.evaluate_once()
+    assert [a["rule"] for a in active] == ["trainer.eps_burn"]
+    assert active[0]["value"] == 7.5 and active[0]["threshold"] == 5.0
+
+
+def test_sick_rule_counts_check_error_not_ticker_death(monkeypatch):
+    monkeypatch.setenv("QFEDX_WATCH", "1")
+    monkeypatch.setenv("QFEDX_WATCH_STALL_S", "not-a-float")
+    obs_server.set_health_source(
+        "trainer", lambda: {"last_flush_age_s": 999.0}
+    )
+    try:
+        assert watch.evaluate_once() == []  # sick rule quiet, not fatal
+        assert (
+            obs.registry().counters["alert.check_error.trainer.stall"] == 1
+        )
+    finally:
+        obs_server.clear_health_source("trainer")
+
+
+# --- pin grammar + default-off invariance -------------------------------------
+
+
+def test_watch_pin_grammar(monkeypatch):
+    for raw, want in (
+        ("1", 1.0), ("on", 1.0), ("ON", 1.0), ("2.5", 2.5), ("0.25", 0.25),
+        ("0", 0.0), ("off", 0.0),
+    ):
+        monkeypatch.setenv("QFEDX_WATCH", raw)
+        assert watch.interval_s() == want
+    monkeypatch.delenv("QFEDX_WATCH")
+    assert watch.interval_s() == 0.0
+    for bad in ("yes", "1s", "-2", "0x1"):
+        monkeypatch.setenv("QFEDX_WATCH", bad)
+        with pytest.raises(ValueError, match="QFEDX_WATCH"):
+            watch.interval_s()
+
+
+def test_watch_default_off_no_thread_no_eval(monkeypatch):
+    import threading
+
+    monkeypatch.delenv("QFEDX_WATCH", raising=False)
+    assert not watch.enabled()
+    assert watch.maybe_start() is False
+    assert watch.evaluate_once() == []
+    assert not any(
+        t.name == "qfedx-watchdog" for t in threading.enumerate()
+    )
+    # and with the metrics port also unset, instruments stay no-ops
+    monkeypatch.delenv("QFEDX_METRICS_PORT", raising=False)
+    monkeypatch.delenv("QFEDX_TRACE", raising=False)
+    obs.counter("serve.requests_shed", 3)
+    assert obs.registry().counters == {}
+
+
+def test_watch_ticker_runs_and_stops(monkeypatch):
+    import time
+
+    monkeypatch.setenv("QFEDX_WATCH", "0.01")
+    assert watch.maybe_start() is True
+    assert watch.maybe_start() is True  # idempotent
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if obs.registry().gauges.get("alert.serve.shed_rate") is not None:
+            break
+        time.sleep(0.01)
+    else:
+        pytest.fail("ticker never evaluated")
+    watch.stop()
+    import threading
+
+    assert not any(
+        t.name == "qfedx-watchdog" for t in threading.enumerate()
+    )
+
+
+def test_watch_implies_bounded_instruments(monkeypatch):
+    monkeypatch.delenv("QFEDX_TRACE", raising=False)
+    monkeypatch.setenv("QFEDX_WATCH", "1")
+    assert obs.metrics_enabled()
+    obs.counter("serve.requests_shed", 2)
+    assert obs.registry().counters["serve.requests_shed"] == 2.0
+    # spans stay gated on QFEDX_TRACE — unbounded state needs the pin
+    with obs.span("round.dispatch"):
+        pass
+    assert obs.registry().spans == []
+
+
+# --- the flight recorder ------------------------------------------------------
+
+
+def test_flight_default_off_records_nothing(monkeypatch):
+    monkeypatch.delenv("QFEDX_FLIGHT", raising=False)
+    assert not flight.enabled()
+    flight.record("lifecycle", "x", a=1)
+    assert flight.events() == []
+    assert flight.dump() is None  # nothing to dump, no file
+
+
+def test_flight_ring_is_bounded(monkeypatch, tmp_path):
+    monkeypatch.setenv("QFEDX_FLIGHT", "8")
+    for i in range(20):
+        flight.record("counter", f"c{i}", v=i)
+    evs = flight.events()
+    assert len(evs) == 8
+    assert flight.dropped() == 12
+    assert evs[-1]["name"] == "c19"  # newest kept, oldest shed
+    path = flight.dump(tmp_path / "flight.json", reason="test")
+    doc = json.loads(path.read_text())
+    assert doc["reason"] == "test" and doc["dropped"] == 12
+    assert len(doc["events"]) == 8
+    assert path.stat().st_size <= flight.byte_bound()
+
+
+def test_flight_on_value_and_grammar(monkeypatch):
+    monkeypatch.setenv("QFEDX_FLIGHT", "on")
+    assert flight.capacity() == flight.DEFAULT_CAPACITY == 256
+    monkeypatch.setenv("QFEDX_FLIGHT", "bogus")
+    with pytest.raises(ValueError, match="QFEDX_FLIGHT"):
+        flight.capacity()
+
+
+def test_flight_truncates_unbounded_fields(monkeypatch, tmp_path):
+    monkeypatch.setenv("QFEDX_FLIGHT", "4")
+    flight.record("span", "x" * 10_000, detail="y" * 10_000)
+    ev = flight.events()[0]
+    assert len(ev["name"]) <= 160 and len(ev["detail"]) <= 160
+    path = flight.dump(tmp_path / "f.json")
+    assert path.stat().st_size <= flight.byte_bound()
+
+
+def test_sigterm_run_leaves_parseable_bounded_flight_json(
+    monkeypatch, tmp_path
+):
+    """The black-box acceptance: a SIGTERM'd run (in-process kill, the
+    utils/host translation — the test_stream idiom) leaves a valid
+    flight.json in the run dir, within the configured byte bound,
+    stamped with the unwind reason. Default pins otherwise — no
+    QFEDX_TRACE required."""
+    monkeypatch.setenv("QFEDX_FLIGHT", "32")
+    from qfedx_tpu.run.metrics import ExperimentRun
+    from qfedx_tpu.utils.host import (
+        install_sigterm_interrupt,
+        restore_sigterm,
+    )
+
+    token = install_sigterm_interrupt()
+    try:
+        with pytest.raises(KeyboardInterrupt, match="SIGTERM"):
+            with ExperimentRun(tmp_path, name="doomed") as run:
+                for i in range(50):
+                    flight.record("counter", "fed.round", round=i)
+                os.kill(os.getpid(), signal_mod.SIGTERM)
+    finally:
+        restore_sigterm(token)
+
+    dump_path = run.dir / "flight.json"
+    assert dump_path.exists()
+    doc = json.loads(dump_path.read_text())  # parses or the test fails
+    assert doc["reason"] == "KeyboardInterrupt"
+    assert doc["capacity"] == 32
+    assert 0 < len(doc["events"]) <= 32
+    assert doc["events"][-1]["name"] == "fed.round"
+    assert dump_path.stat().st_size <= flight.byte_bound()
+    ld = flight.last_dump()
+    assert ld["path"] == str(dump_path) and ld["reason"] == "KeyboardInterrupt"
+
+
+def test_alert_firing_snapshots_the_flight_ring(monkeypatch, tmp_path):
+    monkeypatch.setenv("QFEDX_FLIGHT", "16")
+    monkeypatch.setenv("QFEDX_WATCH", "1")
+    monkeypatch.setenv("QFEDX_WATCH_EPS", "1.0")
+    flight.set_dump_path(tmp_path / "flight.json")
+    obs.gauge("fed.epsilon", 3.0)
+    watch.evaluate_once()
+    doc = json.loads((tmp_path / "flight.json").read_text())
+    assert doc["reason"] == "alert.trainer.eps_burn"
+    assert any(
+        e["kind"] == "alert" and e["name"] == "trainer.eps_burn"
+        for e in doc["events"]
+    )
