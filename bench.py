@@ -1040,6 +1040,36 @@ def _bench_serve(jax, n_qubits=16, n_layers=3, requests_per_rate=384):
         _watch.evaluate_once()
         alert_totals = _watch.fired_totals()
 
+        # r21 tuned lever: run the offline tuner's deadline lattice over
+        # the SAME model (tune/offline.py — the `qfedx tune` engine) and
+        # report the winning cell next to the default one, so vs_prev
+        # tracks throughput_at_slo tuned-vs-default as a lever row. The
+        # per-route persistent-forward cache hands the equal-route cells
+        # their already-compiled programs.
+        from qfedx_tpu.tune import offline as _tune_offline
+
+        try:
+            tuned_sweep = _tune_offline.sweep_serve(
+                model, params, (n_qubits,),
+                slo_ms=cfg.slo_ms,
+                bucket_sets=(cfg.buckets,),
+                deadlines_ms=(2.5, 5.0, 10.0),
+                requests=min(requests_per_rate, 96),
+                rate_fracs=(0.5, 0.8),
+                max_queue=cfg.max_queue,
+            )
+            tuned_best = tuned_sweep["best"]
+            tuned = {
+                "deadline_ms": tuned_best["deadline_ms"],
+                "buckets": tuned_best["buckets"],
+                "throughput_at_slo": tuned_best["throughput_at_slo"],
+                "p50_ms": tuned_best["p50_ms"],
+                "p95_ms": tuned_best["p95_ms"],
+                "cells": len(tuned_sweep["cells"]),
+            }
+        except Exception as exc:  # noqa: BLE001 — a broken tuner must not
+            tuned = {"error": str(exc)}  # sink the serve rows themselves
+
         ok = [
             r for r in rates.values()
             if r.get("p95_ms") is not None
@@ -1075,6 +1105,7 @@ def _bench_serve(jax, n_qubits=16, n_layers=3, requests_per_rate=384):
             "serve_p95_ms": best["p95_ms"] if best else None,
             "alerts_fired": int(sum(alert_totals.values())),
             "alerts_by_rule": alert_totals or None,
+            "tuned": tuned,
         }
 
     # QFEDX_TRACE on for the whole section: the compile listener is the
@@ -1410,6 +1441,19 @@ def _bench_time_to_target_20q(jax, target=0.90, max_rounds=15):
 # them rather than silently producing apples-to-oranges ratios (the r05
 # run compared against BENCH_r03 exactly this way — ADVICE r05).
 _FIRST_COMPARABLE_ROUND = 4
+
+
+def _write_json_atomic(path: str, text: str) -> None:
+    """Sidecar write discipline (r21): tmp + rename with a trailing
+    newline — a reader (or this process, killed mid-write) can never
+    observe a torn JSON document. The printed compact line gets the
+    same whole-line guarantee via one flushed stdout write; the
+    tail-recovery path in _load_prev_bench stays for the pre-r21
+    snapshots that were truncated before this discipline existed."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text if text.endswith("\n") else text + "\n")
+    os.replace(tmp, path)
 
 
 def _bench_round_num(path: str) -> int | None:
@@ -1895,6 +1939,17 @@ def main():
                 (prev.get("serve") or {}).get("throughput_at_slo"),
                 True,
             )
+            # r21 tuned lever: the offline tuner's winning cell vs its
+            # own previous round — a tuned number that stops beating the
+            # default is the auto-tuner regressing, not serving.
+            delta(
+                "serve_tuned_throughput_at_slo",
+                (serve.get("tuned") or {}).get("throughput_at_slo"),
+                ((prev.get("serve") or {}).get("tuned") or {}).get(
+                    "throughput_at_slo"
+                ),
+                True,
+            )
             # r16 floor attribution: a growing measured gap or op count
             # is exactly the regression the §15 model prices. Only
             # compared when the profiled width matches (the row is
@@ -1988,10 +2043,9 @@ def main():
             "stale_baseline": len(_lint.stale_baseline),
             "delta": _lint.delta_line(),
         }
-        with open(os.path.join(
+        _write_json_atomic(os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "bench_lint.json"
-        ), "w") as f:
-            f.write(render_json(_lint))
+        ), render_json(_lint))
         print(lint_row["delta"])
     except Exception as e:  # noqa: BLE001 — lint must never kill bench
         lint_row = {"error": f"{type(e).__name__}: {e}"}
@@ -2047,8 +2101,7 @@ def main():
         os.path.dirname(os.path.abspath(__file__)), "bench_details.json"
     )
     try:
-        with open(sidecar, "w") as f:
-            json.dump(details, f, indent=1)
+        _write_json_atomic(sidecar, json.dumps(details, indent=1))
     except Exception:  # noqa: BLE001 — the printed line is the contract
         sidecar = None
 
@@ -2065,8 +2118,7 @@ def main():
         k for k, v in vs_prev.items()
         if isinstance(v, dict) and v.get("regressed")
     ]
-    print(
-        json.dumps(
+    line = json.dumps(
             {
                 "metric": "vqc_client_rounds_per_sec_per_chip",
                 "value": round(value, 3),
@@ -2194,7 +2246,7 @@ def main():
                     for k in (
                         "serve_p50_ms", "serve_p95_ms",
                         "throughput_at_slo", "slo_ms", "capacity_rps",
-                        "zero_compiles_in_loop",
+                        "zero_compiles_in_loop", "tuned",
                     )
                 }
                 if "error" not in serve
@@ -2233,15 +2285,19 @@ def main():
                 "regressed": regressed,
                 "details": "bench_details.json" if sidecar else None,
             }
-        )
     )
+    # Whole-line stdout contract (r21): ONE flushed write — the driver's
+    # tail capture can never interleave with or truncate the compact row
+    # (the committed r04 snapshot is exactly that failure mode).
+    sys.stdout.write(line + "\n")
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":
     try:
         main()
     except Exception as e:  # never leave the driver without a JSON line
-        print(
+        sys.stdout.write(
             json.dumps(
                 {
                     "metric": "vqc_client_rounds_per_sec_per_chip",
@@ -2250,6 +2306,7 @@ if __name__ == "__main__":
                     "vs_baseline": 0.0,
                     "error": f"{type(e).__name__}: {e}",
                 }
-            )
+            ) + "\n"
         )
+        sys.stdout.flush()
         sys.exit(1)

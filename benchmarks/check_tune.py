@@ -1,0 +1,45 @@
+"""Lint: tune/controller decisions match the documented taxonomy.
+
+Thin wrapper (the check_pins/check_spans pattern): the single
+definition lives on the unified analysis engine —
+``qfedx_tpu.analysis.rules_doc`` (rule **QFX107** under ``qfedx
+lint``; docs/ANALYSIS.md has the taxonomy). The contract: every
+decision ID in ``tune/controller.DECISIONS`` has a row in
+docs/OBSERVABILITY.md's "## Tune decision taxonomy" table, every row
+names a live decision, and each row's threshold-pin cell names the pin
+the controller actually compares against — the operator reading a
+``{"event": "tune"}`` row looks the ID up in exactly one place, which
+must not lie about the knob that changes the behaviour.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from qfedx_tpu.analysis.rules_doc import (  # noqa: E402,F401
+    check_tune,
+    documented_tune_decisions,
+)
+
+
+def main() -> int:
+    problems = check_tune()
+    if problems:
+        print("tune-decision taxonomy drift (docs/OBSERVABILITY.md):")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print(
+        f"ok: {len(documented_tune_decisions())} tune decisions, "
+        "tune/controller.py and docs/OBSERVABILITY.md table agree"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

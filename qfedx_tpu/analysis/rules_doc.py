@@ -369,3 +369,102 @@ register(Rule(
     "taxonomy table agree — IDs both directions, threshold pins exact",
     _run_alert_taxonomy,
 ))
+
+
+# -- QFX107 (tune-decision taxonomy) -------------------------------------------
+#
+# The auto-tuner's adaptation contract (r21): every decision ID in
+# tune/controller.DECISIONS needs a row in docs/OBSERVABILITY.md's
+# "## Tune decision taxonomy" table, every row must name a live
+# decision, and each row's threshold-pin cell must name the pin the
+# controller actually compares against — an operator reading a
+# ``{"event": "tune", "decision": "deadline.tighten"}`` row looks the
+# ID up in exactly one place, and that place must not lie about which
+# knob changes the behaviour.
+
+TUNE_DOC = "docs/OBSERVABILITY.md"
+_TUNE_HEADING = "## Tune decision taxonomy"
+_TUNE_ROW = re.compile(r"^\|\s*`([a-z0-9_.]+)`")
+
+
+def documented_tune_decisions(
+    doc_path: str | Path | None = None,
+) -> dict[str, str]:
+    """``{decision_id: threshold_pin_cell}`` parsed from the tune
+    decision taxonomy table (columns: decision ID | signal |
+    threshold pin | means)."""
+    path = Path(doc_path) if doc_path else _default_repo_root() / TUNE_DOC
+    out: dict[str, str] = {}
+    in_section = False
+    for line in path.read_text().splitlines():
+        stripped = line.strip()
+        if stripped.startswith("#"):
+            in_section = stripped.startswith(_TUNE_HEADING)
+            continue
+        if not in_section or not _TUNE_ROW.match(stripped):
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if len(cells) >= 3:
+            ticked = _TICKED.findall(cells[2])
+            out[cells[0].strip("`")] = ticked[0] if ticked else ""
+    return out
+
+
+def check_tune(doc_path: str | Path | None = None) -> list[str]:
+    """Problem strings (empty = clean) — the standalone surface
+    benchmarks/check_tune.py and tests/test_check_pins.py share."""
+    from qfedx_tpu.tune import decision_taxonomy
+
+    code = decision_taxonomy()
+    doc = documented_tune_decisions(doc_path)
+    problems = []
+    for did, spec in sorted(code.items()):
+        if did not in doc:
+            problems.append(
+                f"tune decision {did} (tune/controller.py) has no row in "
+                "the docs/OBSERVABILITY.md tune decision taxonomy table"
+            )
+        elif doc[did] != spec["threshold_pin"]:
+            problems.append(
+                f"tune decision {did}: taxonomy row names threshold pin "
+                f"{doc[did]!r}, tune/controller.py reads "
+                f"{spec['threshold_pin']!r}"
+            )
+    for did in sorted(set(doc) - set(code)):
+        problems.append(
+            f"tune-decision taxonomy row {did} matches no decision in "
+            "tune/controller.py (stale doc row?)"
+        )
+    return problems
+
+
+def _run_tune_taxonomy(ctx: LintContext) -> list[Finding]:
+    doc = ctx.doc(TUNE_DOC)
+    if not doc.exists():
+        return [Finding(
+            "QFX107", TUNE_DOC, 1,
+            f"{TUNE_DOC} is missing — it carries the tune decision "
+            "taxonomy table (the auto-tuner's operator contract)",
+        )]
+    try:
+        problems = check_tune(doc)
+    except Exception as exc:  # noqa: BLE001 — a moved surface is a finding
+        return [Finding(
+            "QFX107", TUNE_DOC, 1,
+            f"tune-taxonomy source unavailable: {exc}",
+        )]
+    rows = _section_rows(doc, _TUNE_HEADING, _TUNE_ROW, skip="decision ID")
+    out = []
+    for p in problems:
+        line = next((ln for did, ln in rows.items() if did in p), 1)
+        out.append(Finding("QFX107", TUNE_DOC, line, p))
+    return out
+
+
+register(Rule(
+    "QFX107", "tune-taxonomy",
+    "tune/controller decisions and the docs/OBSERVABILITY.md tune "
+    "decision taxonomy table agree — IDs both directions, threshold "
+    "pins exact",
+    _run_tune_taxonomy,
+))

@@ -63,13 +63,21 @@ class Histogram:
     edge(NUM_BUCKETS), lower edge = that edge).
     """
 
-    __slots__ = ("_counts", "count", "sum", "_lock")
+    __slots__ = (
+        "_counts", "count", "sum", "_lock",
+        "_base_counts", "_base_count", "_base_sum",
+    )
 
     def __init__(self):
         self._counts = [0] * (NUM_BUCKETS + 2)
         self.count = 0
         self.sum = 0.0
         self._lock = threading.Lock()
+        # snapshot_delta baseline — allocated lazily on the first call so
+        # histograms that never use windows stay at the stated ~2.3 KB.
+        self._base_counts: list[int] | None = None
+        self._base_count = 0
+        self._base_sum = 0.0
 
     @staticmethod
     def _index(value: float) -> int:
@@ -141,23 +149,64 @@ class Histogram:
                 "p95": self.percentile_unlocked(0.95),
             }
 
+    def snapshot_delta(self) -> dict:
+        """Window view: counts/sum/quantiles over everything recorded
+        SINCE the previous ``snapshot_delta`` call (or construction), then
+        rebase the window. Same nearest-rank lower-edge quantile rule as
+        ``percentile``, applied to the window's bucket counts only — a
+        controller polling this sees "the last tick's p95", not the
+        lifetime p95 a long-lived server's history would freeze.
+
+        One consumer owns the window: two pollers calling this on the
+        same instrument split the stream between them (each rebase
+        consumes the delta). Concurrent ``record`` calls are safe — the
+        whole read-and-rebase happens under the instrument lock.
+        """
+        with self._lock:
+            if self._base_counts is None:
+                delta = list(self._counts)
+                count = self.count
+                s = self.sum
+            else:
+                delta = [
+                    c - b for c, b in zip(self._counts, self._base_counts)
+                ]
+                count = self.count - self._base_count
+                s = self.sum - self._base_sum
+            self._base_counts = list(self._counts)
+            self._base_count = self.count
+            self._base_sum = self.sum
+            return {
+                "count": count,
+                "sum": round(s, 9),
+                "p50": _rank_percentile(delta, count, 0.50),
+                "p95": _rank_percentile(delta, count, 0.95),
+            }
+
     # percentile() takes the lock; snapshot() already holds it. The lock
     # is not reentrant (plain Lock — cheaper on the record hot path), so
     # snapshot uses this unlocked twin.
     def percentile_unlocked(self, q: float) -> float:
-        if self.count == 0:
-            return 0.0
-        rank = min(self.count - 1, max(0, int(round(q * (self.count - 1)))))
-        seen = 0
-        for idx, c in enumerate(self._counts):
-            seen += c
-            if seen > rank:
-                if idx == 0:
-                    return 0.0
-                return bucket_edge(idx - 1) if idx <= NUM_BUCKETS else (
-                    bucket_edge(NUM_BUCKETS)
-                )
-        return 0.0
+        return _rank_percentile(self._counts, self.count, q)
 
     def __repr__(self) -> str:  # debugging aid only
         return f"Histogram(count={self.count}, sum={self.sum:.6g})"
+
+
+def _rank_percentile(counts: list[int], count: int, q: float) -> float:
+    """THE nearest-rank lower-edge rule over a bucket-count vector —
+    shared by lifetime (``percentile``) and window (``snapshot_delta``)
+    views so the two can never disagree on the definition."""
+    if count <= 0:
+        return 0.0
+    rank = min(count - 1, max(0, int(round(q * (count - 1)))))
+    seen = 0
+    for idx, c in enumerate(counts):
+        seen += c
+        if seen > rank:
+            if idx == 0:
+                return 0.0
+            return bucket_edge(idx - 1) if idx <= NUM_BUCKETS else (
+                bucket_edge(NUM_BUCKETS)
+            )
+    return 0.0

@@ -69,11 +69,58 @@ def _fmt(v: float) -> str:
     return repr(round(v, 9)) if isinstance(v, float) else str(v)
 
 
+def build_info_labels() -> dict[str, str] | None:
+    """Labels of the standard ``qfedx_build_info`` gauge (r21): package +
+    jax versions, the backend, and the RESOLVED serving route
+    (fuse/scan/pallas booleans + state dtype — pallas_body
+    .resolved_route, the same self-description ServeEngine.warmup
+    reports), so a scrape can correlate a latency trend with the route
+    that produced it. Computed per scrape — the route pins are live
+    levers. None when the environment cannot answer (no jax backend):
+    the gauge is then omitted rather than lying."""
+    try:
+        import jax
+        import numpy as np
+
+        from qfedx_tpu import __version__
+        from qfedx_tpu.ops import pallas_body
+        from qfedx_tpu.ops.cpx import state_dtype
+
+        route = pallas_body.resolved_route()
+        return {
+            "version": __version__,
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+            "dtype": np.dtype(state_dtype()).name,
+            "fuse": str(bool(route.get("fuse"))).lower(),
+            "scan": str(bool(route.get("scan_layers"))).lower(),
+            "pallas": str(bool(route.get("pallas"))).lower(),
+        }
+    except Exception:  # noqa: BLE001 — telemetry must degrade, not raise
+        return None
+
+
+def _render_build_info(lines: list[str]) -> None:
+    labels = build_info_labels()
+    if labels is None:
+        return
+    esc = {
+        k: str(v).replace("\\", "\\\\").replace('"', '\\"')
+        for k, v in labels.items()
+    }
+    pairs = ",".join(f'{k}="{v}"' for k, v in sorted(esc.items()))
+    lines.append("# TYPE qfedx_build_info gauge")
+    lines.append(f"qfedx_build_info{{{pairs}}} 1")
+
+
 def render_prometheus() -> str:
     """The registry as Prometheus 0.0.4 text. Pure function of the
-    registry — callable without a server (tests, ad-hoc dumps)."""
+    registry — callable without a server (tests, ad-hoc dumps) — plus
+    the one environmental constant: the labeled ``qfedx_build_info``
+    gauge (value 1) leading the exposition."""
     counters, gauges, histos, span_histos = trace.registry().instruments()
     lines: list[str] = []
+    _render_build_info(lines)
     for name, val in sorted(counters.items()):
         pn = _prom_name(name)
         lines.append(f"# TYPE {pn} counter")

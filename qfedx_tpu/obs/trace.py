@@ -70,13 +70,18 @@ def set_live_metrics(on: bool) -> None:
 def metrics_enabled() -> bool:
     """Should counters/gauges/histograms record? True when QFEDX_TRACE
     is on, OR a live /metrics endpoint is serving, OR the r20 watchdog
-    is enabled (bounded state only — a watchdog evaluating an empty
-    registry would be blind; see set_live_metrics / obs.watch)."""
+    is enabled, OR the r21 tune controller is enabled (bounded state
+    only — a watchdog or controller evaluating an empty registry would
+    be blind; see set_live_metrics / obs.watch / tune.controller)."""
     if _live_metrics or enabled():
         return True
     from qfedx_tpu.obs import watch
 
-    return watch.enabled()
+    if watch.enabled():
+        return True
+    from qfedx_tpu.tune import controller as _tune
+
+    return _tune.enabled()
 
 
 def xla_annotations_enabled() -> bool:

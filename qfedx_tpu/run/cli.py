@@ -162,6 +162,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "gets a phase_breakdown rollup, and a Perfetto/"
                         "chrome://tracing-loadable trace.json lands in the "
                         "run dir (docs/OBSERVABILITY.md)")
+    t.add_argument("--tuned", default=None, metavar="PATH",
+                   help="restore the pin set from a `qfedx tune` "
+                        "best_config.json sidecar before building the run "
+                        "config (route pins retune training too); pins the "
+                        "operator already set win (docs/OBSERVABILITY.md)")
 
     v = sub.add_parser(
         "serve",
@@ -191,6 +196,44 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("--trace", action="store_true",
                    help="record serve.* spans and write trace.json next to "
                         "the run dir's artifacts (docs/OBSERVABILITY.md)")
+    v.add_argument("--tuned", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="restore the tuned pin set from a `qfedx tune` "
+                        "best_config.json sidecar before resolving the "
+                        "serve config (bare --tuned reads <run-dir>/"
+                        "best_config.json); pins the operator already set "
+                        "win, explicit --buckets/--deadline-ms flags "
+                        "always win (docs/OBSERVABILITY.md)")
+
+    tn = sub.add_parser(
+        "tune",
+        help="offline auto-tuner: sweep the serve bucket/deadline/route "
+             "lattice against a trained run's checkpoint and write the "
+             "winner as a best_config.json sidecar that `qfedx serve "
+             "--tuned` / `qfedx train --tuned` restore through pins "
+             "(docs/OBSERVABILITY.md)",
+    )
+    tn.add_argument("--run-dir", required=True,
+                    help="a tracked run directory (config.json + "
+                         "checkpoints/)")
+    tn.add_argument("--round", type=int, default=None,
+                    help="restore this checkpointed round (default: newest "
+                         "last-good checkpoint)")
+    tn.add_argument("--slo-ms", type=float, default=None,
+                    help="latency SLO the score holds cells to (default: "
+                         "the resolved serve SLO)")
+    tn.add_argument("--buckets", default=None,
+                    help="semicolon-separated bucket SETS, each a comma-"
+                         "separated ascending list (e.g. '1,8;1,8,32'); "
+                         "default: the resolved serve bucket set only")
+    tn.add_argument("--deadlines", default=None,
+                    help="comma-separated micro-batcher flush deadlines in "
+                         "ms to sweep (e.g. '2.5,5,10'); default: the "
+                         "resolved deadline only")
+    tn.add_argument("--requests", type=int, default=96,
+                    help="offered-load requests per (cell, rate) point")
+    tn.add_argument("--out", default=None,
+                    help="sidecar path (default <run-dir>/best_config.json)")
 
     i = sub.add_parser(
         "inspect",
@@ -325,6 +368,7 @@ def config_from_args(a: argparse.Namespace) -> ExperimentConfig:
         seed=a.seed,
         run_root=a.run_root,
         name=a.name,
+        tuned_from=getattr(a, "tuned", None) or None,
     )
 
 
@@ -521,6 +565,20 @@ def run_serve(args) -> dict:
         obs.reset()
     say = print if is_primary() else (lambda *a, **k: None)
 
+    if getattr(args, "tuned", None) is not None:
+        # r21: replay the `qfedx tune` winner as pins BEFORE the config
+        # resolves, so ServeConfig.resolve and the route both see it.
+        # Operator-set pins are skipped inside apply_best_config, and
+        # explicit --buckets/--deadline-ms flags below still win.
+        from qfedx_tpu.tune import offline as tune_offline
+
+        tuned_path = args.tuned or args.run_dir
+        applied = tune_offline.apply_best_config(tuned_path)
+        say("[qfedx_tpu] tuned pins applied: "
+            + json.dumps(applied["applied"])
+            + (f" (operator kept: {sorted(applied['skipped'])})"
+               if applied["skipped"] else ""))
+
     buckets = (
         tuple(int(b) for b in args.buckets.split(",")) if args.buckets
         else None
@@ -665,6 +723,47 @@ def run_serve(args) -> dict:
     }
     say("[qfedx_tpu] serve summary: " + json.dumps(summary))
     return summary
+
+
+def run_tune(args) -> dict:
+    """``qfedx tune``: the offline half of the closed loop. Restores the
+    run's checkpoint once, sweeps the (bucket set × deadline × route)
+    lattice through the real serving stack, and writes the winning cell
+    as a ``best_config.json`` pin sidecar (tune/offline.py)."""
+    from qfedx_tpu.tune import offline as tune_offline
+    from qfedx_tpu.utils.host import is_primary
+
+    say = print if is_primary() else (lambda *a, **k: None)
+    bucket_sets = (
+        tuple(
+            tuple(int(b) for b in grp.split(","))
+            for grp in args.buckets.split(";") if grp.strip()
+        )
+        if args.buckets else None
+    )
+    deadlines = (
+        tuple(float(d) for d in args.deadlines.split(","))
+        if args.deadlines else None
+    )
+    record = tune_offline.tune_run_dir(
+        args.run_dir,
+        round_idx=args.round,
+        slo_ms=args.slo_ms,
+        bucket_sets=bucket_sets,
+        deadlines_ms=deadlines,
+        requests=args.requests,
+        out_path=args.out,
+    )
+    say(f"[qfedx_tpu] tuned {args.run_dir}: {len(record['cells'])} cells "
+        f"swept, winner pins {json.dumps(record['pins'])} "
+        f"(throughput_at_slo={record['score']['throughput_at_slo']}, "
+        f"p95={record['score']['p95_ms']}ms)")
+    say(f"[qfedx_tpu] sidecar: {record['path']} — restore with "
+        "`qfedx serve --tuned`")
+    say("[qfedx_tpu] " + json.dumps(
+        {k: record[k] for k in ("schema", "key", "pins", "score", "path")}
+    ))
+    return record
 
 
 # -- the bench-trajectory regression ledger (r20) ------------------------------
@@ -945,12 +1044,26 @@ def run_inspect(run_dir) -> dict:
         if r.get("event") == "alert" and r.get("state") == "firing":
             rid = str(r.get("rule", "?"))
             alerts_fired[rid] = alerts_fired.get(rid, 0) + 1
+    # The adaptation record (r21): tune-controller decisions per
+    # decision ID, reverts counted apart — shown next to the alert
+    # totals so one inspect answers "what fired AND what adapted".
+    # Tolerant of no-tuner runs (both stay empty/zero).
+    tune_decisions: dict[str, int] = {}
+    tune_reverts = 0
+    for r in event_rows:
+        if r.get("event") == "tune":
+            did = str(r.get("decision", "?"))
+            tune_decisions[did] = tune_decisions.get(did, 0) + 1
+            if r.get("revert"):
+                tune_reverts += 1
     out = {
         "run_dir": str(run_dir),
         "rounds_completed": max((r["round"] for r in rows), default=0),
         "metrics_rows": len(rows),
         "event_rows": len(event_rows),
         "alerts_fired": alerts_fired,
+        "tune_decisions": tune_decisions,
+        "tune_reverts": tune_reverts,
         "invalid_rows": len(invalid),
         "first_accuracy": accs[0] if accs else None,
         "best_accuracy": max(accs) if accs else None,
@@ -1028,6 +1141,22 @@ def run_inspect(run_dir) -> dict:
                 "events": len(fl.get("events", [])),
                 "dropped": fl.get("dropped"),
             }
+    # The tuned sidecar (r21): a best_config.json left by `qfedx tune`
+    # — chosen cell, score, provenance. Absent for untuned runs.
+    tuned_path = run_dir / "best_config.json"
+    if tuned_path.exists():
+        try:
+            tuned = json.loads(tuned_path.read_text())
+        except ValueError:
+            bad_artifacts.append("best_config.json")
+        else:
+            out["tune"] = {
+                "path": str(tuned_path),
+                "pins": tuned.get("pins"),
+                "score": tuned.get("score"),
+                "cells": len(tuned.get("cells") or []),
+                "source": (tuned.get("provenance") or {}).get("source"),
+            }
     # Bench-trajectory adjacency: when this run dir sits inside (or
     # next to) a checkout carrying the committed BENCH_r*.json ledger,
     # attach the compact history row so one inspect answers both "how
@@ -1046,6 +1175,14 @@ def run_inspect(run_dir) -> dict:
         say("[qfedx_tpu] ledger: " + json.dumps(ledger))
     if alerts_fired:
         say("[qfedx_tpu] alerts fired: " + json.dumps(alerts_fired))
+    if tune_decisions:
+        say("[qfedx_tpu] tune decisions: " + json.dumps(tune_decisions)
+            + f" (reverts: {tune_reverts})")
+    if "tune" in out:
+        say(f"[qfedx_tpu] tuned sidecar: {out['tune']['path']} "
+            f"(pins {json.dumps(out['tune']['pins'])}, "
+            f"score {json.dumps(out['tune']['score'])}, "
+            f"{out['tune']['cells']} cells)")
     if "flight" in out:
         say(f"[qfedx_tpu] flight recorder: {out['flight']['path']} "
             f"({out['flight']['bytes']} bytes, "
@@ -1128,11 +1265,23 @@ def main(argv=None):
     if cache_dir and is_primary():
         print(f"[qfedx_tpu] compile cache: {cache_dir}")
     if args.cmd == "train":
+        if args.tuned:
+            # r21: replay tuned pins before the config is built so route
+            # choices (scan depth, pipeline depth, …) land in config.json
+            # with the run. Operator-set pins win inside apply.
+            from qfedx_tpu.tune import offline as tune_offline
+
+            applied = tune_offline.apply_best_config(args.tuned)
+            if is_primary():
+                print("[qfedx_tpu] tuned pins applied: "
+                      + json.dumps(applied["applied"]))
         cfg = config_from_args(args)
         run_train(cfg, resume=args.resume, plots=args.plots,
                   profile=args.profile, trace=args.trace)
     elif args.cmd == "serve":
         run_serve(args)
+    elif args.cmd == "tune":
+        run_tune(args)
     elif args.cmd == "inspect":
         run_inspect(args.run_dir)
     elif args.cmd == "demo":

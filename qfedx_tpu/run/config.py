@@ -102,6 +102,12 @@ class ExperimentConfig:
     seed: int = 42
     run_root: str = "runs"
     name: str | None = None
+    # Tuning provenance (r21): the `qfedx tune` best_config.json sidecar
+    # whose pins were replayed before this config was built (`qfedx
+    # train --tuned`). Informational on restore — the applied pin VALUES
+    # travel in config.json's model/route fields like any other run; this
+    # records where they came from so `qfedx inspect` can say so.
+    tuned_from: str | None = None
 
     def run_name(self) -> str:
         if self.name:

@@ -38,10 +38,11 @@ _REQUIRED_FIELDS: dict[str, Any] = {
     "ts": lambda v: isinstance(v, (int, float)),
 }
 
-# Event rows (r20): the watchdog's structured alert records interleave
-# with round rows in the SAME file, keyed by an "event" field instead
-# of "round" — still schema 1 (round rows are unchanged; consumers that
-# filter on "round" never see these).
+# Event rows (r20/r21): the watchdog's structured alert records and the
+# tune controller's decision records interleave with round rows in the
+# SAME file, keyed by an "event" field instead of "round" — still
+# schema 1 (round rows are unchanged; consumers that filter on "round"
+# never see these).
 _EVENT_REQUIRED_FIELDS: dict[str, Any] = {
     "schema": lambda v: v == METRICS_SCHEMA_VERSION,
     "event": lambda v: isinstance(v, str) and bool(v),
@@ -203,11 +204,17 @@ class ExperimentRun:
         # metrics.jsonl as structured event rows. Both are no-ops unless
         # their pins (QFEDX_FLIGHT / QFEDX_WATCH) are on; the sink is
         # identity-matched on __exit__ so a nested/later run wins.
+        from qfedx_tpu import tune
         from qfedx_tpu.obs import flight, watch
 
         flight.set_dump_path(self.dir / "flight.json")
         self._alert_sink = self.metrics.log
         watch.set_event_sink(self._alert_sink)
+        # r21: the tune controller's decision rows ride the same sink —
+        # {"event": "tune"} rows interleave with round/alert rows so an
+        # offline reader can reconcile every adaptation against the
+        # tune.* counters and qfedx_tune_* gauges.
+        tune.set_event_sink(self._alert_sink)
 
     def on_round_end(self, round_idx: int, metrics: Mapping[str, Any]) -> None:
         self.metrics.log({"round": round_idx + 1, **metrics})
@@ -303,11 +310,13 @@ class ExperimentRun:
         return self
 
     def __exit__(self, exc_type, exc, tb):
+        from qfedx_tpu import tune
         from qfedx_tpu.obs import flight, watch
         from qfedx_tpu.utils.host import restore_sigterm
 
         restore_sigterm(getattr(self, "_sigterm_token", None))
         watch.clear_event_sink(only_if=self._alert_sink)
+        tune.clear_event_sink(only_if=self._alert_sink)
         if exc_type is not None:
             # The black box dumps on ANY unwinding exception — including
             # the KeyboardInterrupt("SIGTERM") translation from

@@ -260,8 +260,19 @@ class MicroBatcher:
     def _take_locked(self) -> tuple[list, str] | None:
         """Under the lock: wait for a flush trigger; pop up to one
         max-bucket of requests. None = closed and empty."""
-        deadline_s = self.config.deadline_ms / 1e3
-        cap = self.engine.max_bucket
+        # The adaptation seam (r21): when the tune controller is
+        # attached (QFEDX_TUNE — engine.warmup), the ACTIVE deadline and
+        # bucket cap come from it, re-read once per flush so a decision
+        # takes effect on the next batch with zero recompiles (the cap
+        # only ever names a warmup-compiled bucket). tuner=None (the
+        # default) reads the static config exactly as before.
+        tuner = getattr(self.engine, "tuner", None)
+        if tuner is not None:
+            deadline_s = tuner.deadline_ms / 1e3
+            cap = tuner.max_bucket
+        else:
+            deadline_s = self.config.deadline_ms / 1e3
+            cap = self.engine.max_bucket
         while True:
             if self._pending and (self._closed or len(self._pending) >= cap):
                 # Bucket-full flush (or the drain's final sweeps): take

@@ -151,6 +151,11 @@ class ServeEngine:
             apply_fn if apply_fn is not None else model.apply
         )
         self._warm = False
+        # The adaptive controller seam (tune/controller.py): attached by
+        # warmup() iff QFEDX_TUNE is on, consulted by the batcher per
+        # flush. None (the default) = the batcher reads this engine's
+        # static config exactly as in r20.
+        self.tuner = None
 
     # -- buckets -------------------------------------------------------------
 
@@ -191,6 +196,17 @@ class ServeEngine:
         flight.record(
             "lifecycle", "engine.warmup", buckets=str(self.config.buckets)
         )
+        # r21 adaptation: the tune controller attaches HERE because the
+        # bucket set it may pick from is exactly the set this warmup is
+        # about to compile — attach-after-warm could race a first flush
+        # against an uncompiled shape. Default off: maybe_controller
+        # returns None and nothing below changes.
+        from qfedx_tpu import tune
+
+        if self.tuner is None:
+            self.tuner = tune.maybe_controller(self)
+        if self.tuner is not None:
+            self.tuner.maybe_start()
         per_bucket = {}
         for b in self.config.buckets:
             x = np.zeros((b,) + self.feature_shape, dtype=np.float32)

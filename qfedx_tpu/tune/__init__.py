@@ -1,0 +1,34 @@
+"""qfedx_tpu.tune — the closed loop: telemetry-driven auto-tuning.
+
+Two halves over one decision vocabulary (docs/OBSERVABILITY.md "Tune
+decision taxonomy", enforced both directions by QFX107):
+
+- **offline** (``tune.offline``, `qfedx tune`): sweep a serving-cell
+  lattice, write a ``best_config.json`` sidecar restored through
+  utils/pins by `qfedx serve --tuned` / `qfedx train --tuned`.
+- **online** (``tune.controller``): an adaptive controller attached at
+  ``ServeEngine.warmup`` that re-picks the active flush deadline and
+  bucket cap from windowed /metrics percentiles — never outside the
+  warmup-compiled bucket set, never while a watchdog alert is firing,
+  and every decision is itself telemetry (``{"event": "tune"}`` rows,
+  ``tune.*`` counters, ``qfedx_tune_*`` gauges, ``tune.decide`` spans,
+  flight-ring entries).
+
+This module stays import-light (no jax, no serve imports at module
+scope): `qfedx lint`'s QFX107 check imports ``decision_taxonomy`` from
+here without paying a backend init. ``tune.offline`` is imported
+lazily by its callers (run/cli.py, bench.py).
+"""
+
+from qfedx_tpu.tune.controller import (  # noqa: F401
+    DECISION_IDS,
+    DECISIONS,
+    MIN_WINDOW_COUNT,
+    TuneController,
+    clear_event_sink,
+    decision_taxonomy,
+    enabled,
+    interval_s,
+    maybe_controller,
+    set_event_sink,
+)

@@ -183,6 +183,32 @@ def pin_is_set(name: str) -> bool:
     return name in os.environ
 
 
+def interval_pin(name: str, on_value: float, default: float = 0.0) -> float:
+    """Resolve a period-in-seconds pin with the on/off grammar as a
+    prefix: unset → ``default`` (0.0 = feature off), ``0``/``off`` → 0.0,
+    ``1``/``on`` → ``on_value`` (the feature's default tick), a bare
+    number → that period, anything else raises. QFEDX_TUNE (the adaptive
+    controller's decision period) speaks this — the same shape
+    QFEDX_WATCH established for the watchdog ticker, factored here so a
+    third ticker pin cannot drift on spelling (module docstring)."""
+    env = os.environ.get(name)
+    if env is None:
+        return default
+    as_bool = parse_onoff(env)
+    if as_bool is not None:
+        return on_value if as_bool else 0.0
+    try:
+        period = float(env)
+    except ValueError:
+        raise ValueError(
+            f"{name}={env!r}: expected '0'/'off', '1'/'on' or a period "
+            "in seconds"
+        ) from None
+    if period < 0:
+        raise ValueError(f"{name}={env!r}: period must be >= 0")
+    return period
+
+
 def depth_pin(name: str, default: int, on_value: int = 1) -> int:
     """Resolve an integer-depth pin with the on/off grammar as a prefix:
     ``0``/``off`` → 0, ``1``/``on`` → ``on_value``, a bare integer → that

@@ -237,6 +237,60 @@ def test_alert_guard_fires_both_directions(tmp_path):
     assert "serve.p95_slo" not in documented_alert_rules(doc)
 
 
+# --- the tune-decision taxonomy guard (r21 satellite, same family) -----------
+
+from benchmarks.check_tune import (  # noqa: E402
+    check_tune,
+    documented_tune_decisions,
+)
+
+
+def test_tune_taxonomy_matches_source():
+    assert check_tune() == []
+
+
+def test_tune_taxonomy_covers_every_decision():
+    # An empty parse would make the drift check vacuously pass; the
+    # table must carry exactly the append-only DECISION_IDS surface,
+    # threshold pins included.
+    from qfedx_tpu.tune import decision_taxonomy
+
+    doc = documented_tune_decisions()
+    code = decision_taxonomy()
+    assert set(doc) == set(code)
+    for did in (
+        "deadline.tighten", "deadline.relax", "buckets.shrink",
+        "buckets.grow", "revert.alert",
+    ):
+        assert did in doc, f"taxonomy lost {did}"
+        assert doc[did] == code[did]["threshold_pin"]
+
+
+def test_tune_guard_fires_both_directions(tmp_path):
+    doc = tmp_path / "OBS.md"
+    doc.write_text(
+        "## Tune decision taxonomy\n\n"
+        "| Decision ID | Signal | Threshold pin | Means |\n"
+        "|---|---|---|---|\n"
+        "| `deadline.tighten` | p95 | `QFEDX_TUNE_HI` | tighten |\n"
+        "| `buckets.shrink` | occupancy | `QFEDX_WRONG_PIN` | shrink |\n"
+        "| `made.up_decision` | nothing | `QFEDX_TUNE_LO` | never |\n"
+    )
+    problems = check_tune(doc)
+    # missing decisions, a wrong-pin cell, and the stale row all fire
+    assert any("deadline.relax" in p for p in problems)
+    assert any(
+        "buckets.shrink" in p and "QFEDX_WRONG_PIN" in p for p in problems
+    )
+    assert any("made.up_decision" in p and "stale" in p for p in problems)
+    assert not any("deadline.tighten" in p for p in problems)
+    # rows outside the section are not taxonomy rows
+    doc.write_text(
+        "## Some other table\n\n| id |\n|---|\n| `deadline.tighten` |\n"
+    )
+    assert "deadline.tighten" not in documented_tune_decisions(doc)
+
+
 def test_fault_guard_fires_both_directions(tmp_path):
     doc = tmp_path / "ROB.md"
     doc.write_text(

@@ -31,13 +31,14 @@ def test_repo_is_clean_modulo_baseline():
 
 
 def test_every_rule_is_registered_and_ran():
-    # The full ID surface ISSUE 15 ships: the engine's own hygiene rule,
-    # five new analyses, and the six doc/contract guards (five rehosted
-    # check_* scripts + the rule taxonomy itself).
+    # The full ID surface: the engine's own hygiene rule, five analyses,
+    # and the doc/contract guards (five rehosted check_* scripts, the
+    # rule taxonomy itself, the r20 alert taxonomy, and the r21 tune
+    # decision taxonomy).
     expected = {
         "QFX000", "QFX001", "QFX002", "QFX003", "QFX004", "QFX005",
         "QFX100", "QFX101", "QFX102", "QFX103", "QFX104", "QFX105",
-        "QFX106",
+        "QFX106", "QFX107",
     }
     assert set(all_rules()) == expected
     assert set(run_lint().rules_run) == expected

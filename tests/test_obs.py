@@ -414,6 +414,78 @@ def test_registry_hammer_concurrent_writers_lose_nothing(traced):
         assert reg.gauges[f"hammer.gauge_{t}"] == float(per_thread - 1)
 
 
+# --- windowed snapshots: Histogram.snapshot_delta (r21) ----------------------
+
+
+def test_snapshot_delta_exact_nearest_rank_on_drift():
+    """The tune controller's window rule: snapshot_delta returns the
+    since-last-call window — count/sum exact, p50/p95 the nearest-rank
+    lower-edge quantile over ONLY the window — so a latency regime
+    change shows up in one tick instead of being averaged into the
+    lifetime distribution."""
+    h = obs.Histogram()
+    fast = [1.0 + 0.1 * i for i in range(50)]
+    for v in fast:
+        h.record(v)
+    w1 = h.snapshot_delta()
+    assert w1["count"] == 50
+    assert w1["sum"] == pytest.approx(sum(fast), rel=1e-9)
+    # Drift: the next window must see ONLY the slow regime.
+    slow = [10.0] * 10 + [200.0] * 10
+    for v in slow:
+        h.record(v)
+    w2 = h.snapshot_delta()
+    assert w2["count"] == 20
+    assert w2["sum"] == pytest.approx(sum(slow), rel=1e-9)
+    for q, got in ((0.50, w2["p50"]), (0.95, w2["p95"])):
+        exact = obs.percentile(sorted(slow), q)
+        lo, _hi = obs.Histogram.bucket_bounds(exact)
+        assert lo - 1e-9 <= got <= exact, (q, got, exact)
+    # p95 reflects the drift, not the 50 fast samples still in the
+    # lifetime counts.
+    assert w2["p95"] > max(fast)
+    assert h.count == 70  # lifetime view untouched by the rebasing
+    # The window is consumed: an idle tick reads an empty window.
+    w3 = h.snapshot_delta()
+    assert w3 == {"count": 0, "sum": 0.0, "p50": 0.0, "p95": 0.0}
+
+
+def test_snapshot_delta_thread_safe_under_hammer():
+    """Writers hammer the histogram while one consumer (the tune
+    ticker's role) drains windows: no observation may be lost or
+    double-counted across the window boundaries."""
+    h = obs.Histogram()
+    threads_n, per_thread = 8, 2000
+    windows: list[dict] = []
+    stop = threading.Event()
+
+    def writer():
+        for i in range(per_thread):
+            h.record(1.0 + (i % 7))
+
+    def consumer():
+        while not stop.is_set():
+            windows.append(h.snapshot_delta())
+
+    threads = [threading.Thread(target=writer) for _ in range(threads_n)]
+    drain = threading.Thread(target=consumer)
+    drain.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    drain.join()
+    windows.append(h.snapshot_delta())  # the remainder
+    total = threads_n * per_thread
+    assert sum(w["count"] for w in windows) == total
+    expect_sum = sum(1.0 + (i % 7) for i in range(per_thread)) * threads_n
+    assert sum(w["sum"] for w in windows) == pytest.approx(
+        expect_sum, rel=1e-6
+    )
+    assert h.count == total  # lifetime counts saw every record too
+
+
 # --- request-scoped trace context (r15 tentpole) -----------------------------
 
 

@@ -519,6 +519,20 @@ def test_metrics_scrape_reconciles_with_batcher_ledger(monkeypatch):
         )
         assert scraped["qfedx_serve_batches"] == b.stats["batches"]
         assert scraped["qfedx_serve_latency_ms_count"] == 3
+        # r21 build-info pin: the exposition leads with ONE labeled
+        # gauge (value 1) naming versions/backend and the resolved
+        # fuse/scan/pallas/dtype route — the process states what it is.
+        build_lines = [
+            line for line in body.splitlines()
+            if line.startswith("qfedx_build_info{")
+        ]
+        assert len(build_lines) == 1 and build_lines[0].endswith(" 1")
+        import jax as _jax
+
+        assert f'backend="{_jax.default_backend()}"' in build_lines[0]
+        for label in ("version=", "jax=", "dtype=", "fuse=", "scan=",
+                      "pallas="):
+            assert label in build_lines[0]
     finally:
         release.set()
         b.close(drain=True)

@@ -1,0 +1,446 @@
+"""The r21 closed tuning loop (tune/controller.py, tune/offline.py).
+
+Covers the acceptance surface of the tentpole: the QFEDX_TUNE pin
+grammar, default-off r20-invariance (no controller object, no tune.*
+instruments), the drifting-load decision path — a real MicroBatcher
+under singles traffic shrinks the bucket cap, an injected latency drift
+tightens the deadline, a firing watchdog alert forces the one legal
+move (revert-to-baseline) — with ZERO compile events after warmup and
+EXACT three-surface reconciliation (metrics.jsonl event rows ==
+tune.* counters == controller totals, gauges back at baseline), the
+relax/grow directions re-opening the lattice, and the offline
+`qfedx tune` sweep → best_config.json → `--tuned` restore round trip.
+
+Shapes are tiny (4 qubits, 1 layer): every invariant here is
+shape-independent; tuned serving NUMBERS are bench.py's job.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from qfedx_tpu import obs, tune
+from qfedx_tpu.models.vqc import make_vqc_classifier
+from qfedx_tpu.obs import flight, watch
+from qfedx_tpu.obs import server as obs_server
+from qfedx_tpu.serve import MicroBatcher, ServeConfig, ServeEngine
+from qfedx_tpu.utils import pins
+
+N = 4
+FEATS = (N,)
+
+
+@pytest.fixture(autouse=True)
+def _clean_tuning_state():
+    obs_server.stop_server()
+    obs.reset()
+    watch.reset()
+    flight.reset()
+    tune.clear_event_sink()
+    yield
+    obs_server.stop_server()
+    watch.reset()
+    flight.reset()
+    tune.clear_event_sink()
+    obs.reset()
+
+
+def _engine(buckets=(1, 2, 4), deadline_ms=20.0, max_queue=64,
+            slo_ms=50.0, seed=0):
+    model = make_vqc_classifier(n_qubits=N, n_layers=1, num_classes=2)
+    params = model.init(jax.random.PRNGKey(seed))
+    cfg = ServeConfig(
+        buckets=buckets, deadline_ms=deadline_ms,
+        max_queue=max_queue, slo_ms=slo_ms,
+    )
+    return ServeEngine(model, params, FEATS, config=cfg)
+
+
+def _rows(m, seed=0):
+    return np.random.default_rng(seed).uniform(0, 1, (m, N)).astype(
+        np.float32
+    )
+
+
+def _compile_total():
+    return sum(
+        v for k, v in obs.registry().counters.items()
+        if k.startswith("compile.")
+    )
+
+
+def _write_run_dir(tmp_path, seed=7):
+    # The serve-restore fixture shape (tests/test_serve.py): a tracked
+    # config.json + one checkpoint is everything `qfedx tune` needs.
+    from qfedx_tpu.fed.config import FedConfig
+    from qfedx_tpu.run.checkpoint import Checkpointer
+    from qfedx_tpu.run.config import (
+        DataConfig,
+        ExperimentConfig,
+        ModelConfig,
+        build_model,
+    )
+    from qfedx_tpu.run.metrics import _jsonable
+
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="iris", classes=(0, 1), num_clients=2),
+        model=ModelConfig(model="vqc", n_qubits=N, n_layers=1),
+        fed=FedConfig(batch_size=8),
+        seed=seed,
+    )
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    (run_dir / "config.json").write_text(json.dumps(_jsonable(cfg)))
+    model = build_model(cfg, 2)
+    params = model.init(jax.random.PRNGKey(seed))
+    Checkpointer(run_dir / "checkpoints", every=1).save(3, params)
+    return run_dir
+
+
+# -- pin grammar ---------------------------------------------------------------
+
+
+def test_tune_pin_speaks_the_interval_grammar(monkeypatch):
+    monkeypatch.delenv("QFEDX_TUNE", raising=False)
+    assert tune.interval_s() == 0.0 and not tune.enabled()
+    for off in ("0", "off"):
+        monkeypatch.setenv("QFEDX_TUNE", off)
+        assert tune.interval_s() == 0.0 and not tune.enabled()
+    for on in ("1", "on"):
+        monkeypatch.setenv("QFEDX_TUNE", on)
+        assert tune.interval_s() == 1.0 and tune.enabled()
+    monkeypatch.setenv("QFEDX_TUNE", "2.5")
+    assert tune.interval_s() == 2.5
+    for bad in ("fast", "-3"):
+        monkeypatch.setenv("QFEDX_TUNE", bad)
+        with pytest.raises(ValueError, match="QFEDX_TUNE"):
+            tune.interval_s()
+
+
+# -- default-off invariance (the r20 contract) ---------------------------------
+
+
+def test_default_off_is_bit_identical_to_static_serving(monkeypatch):
+    """QFEDX_TUNE unset: warmup attaches NO controller, the batcher
+    reads its static config, and not one tune.* instrument exists —
+    the r20 serving path, untouched."""
+    monkeypatch.delenv("QFEDX_TUNE", raising=False)
+    engine = _engine()
+    engine.warmup()
+    assert engine.tuner is None
+    assert tune.maybe_controller(engine) is None
+    with MicroBatcher(engine) as b:
+        futs = [b.submit(r) for r in _rows(4)]
+        for f in futs:
+            f.result(timeout=30)
+    assert b.stats["served"] == 4
+    reg = obs.registry()
+    assert not any(k.startswith("tune.") for k in reg.counters)
+    assert not any(k.startswith("tune.") for k in reg.gauges)
+    # a hand-built controller is equally inert while the pin is off
+    ctl = tune.TuneController(engine)
+    assert ctl.decide_once() == []
+    assert ctl.totals == {"decisions": 0, "reverts": 0}
+
+
+# -- the tentpole acceptance path ----------------------------------------------
+
+
+def test_drifting_load_decides_reverts_and_reconciles(
+    monkeypatch, tmp_path
+):
+    """The closed loop end to end: singles traffic shrinks the bucket
+    cap, a latency drift tightens the deadline, a firing alert reverts
+    both to baseline — zero compiles after warmup, and the event rows,
+    tune.* counters, controller totals and gauges reconcile EXACTLY."""
+    monkeypatch.setenv("QFEDX_TRACE", "1")
+    monkeypatch.setenv("QFEDX_TUNE", "60")  # enabled; ticker dormant here
+    monkeypatch.delenv("QFEDX_WATCH", raising=False)
+    # The watchdog's serve.p95_slo rule reads the LIFETIME p95 against
+    # this pin; park it far above the injected drift so the one alert in
+    # play is the injected trainer.loss. The controller is unaffected:
+    # it reads the engine's EXPLICIT ServeConfig.slo_ms (CLI > pin).
+    monkeypatch.setenv("QFEDX_SERVE_SLO_MS", "100000")
+    obs.reset()
+
+    from qfedx_tpu.run.metrics import ExperimentRun, validate_metrics_record
+
+    engine = _engine(buckets=(1, 2, 4), deadline_ms=20.0, slo_ms=50.0)
+    decisions = []
+    with ExperimentRun(tmp_path, name="tunerun") as run:
+        engine.warmup()
+        ctl = engine.tuner
+        assert isinstance(ctl, tune.TuneController)
+        try:
+            compiled_at_warmup = _compile_total()
+            assert compiled_at_warmup > 0
+
+            # tick 1 is a counter BASELINE, never a decision
+            assert ctl.decide_once() == []
+
+            # singles trickle: mean occupancy 1.0 <= 0.25*4 -> shrink 4->2
+            with MicroBatcher(engine) as b:
+                for r in _rows(6):
+                    b.submit(r).result(timeout=30)
+            got = ctl.decide_once()
+            decisions += got
+            assert [d["decision"] for d in got] == ["buckets.shrink"]
+            assert ctl.max_bucket == 2 and got[0]["to"] == 2
+
+            # latency drift: window p95 >= 0.8*SLO -> deadline 20->10
+            for _ in range(tune.MIN_WINDOW_COUNT + 4):
+                obs.histogram("serve.latency_ms", 100.0)
+            got = ctl.decide_once()
+            decisions += got
+            assert [d["decision"] for d in got] == ["deadline.tighten"]
+            assert ctl.deadline_ms == 10.0
+
+            # the batcher consults the ACTIVE cap per flush: two queued
+            # requests are now a FULL bucket, not a deadline wait
+            with MicroBatcher(engine) as b:
+                futs = [b.submit(r) for r in _rows(2)]
+                for f in futs:
+                    f.result(timeout=30)
+            assert b.stats["served"] == 2
+
+            # detection outranks adaptation: a firing alert makes
+            # revert-to-baseline the ONLY legal move...
+            monkeypatch.setenv("QFEDX_WATCH", "1")
+            obs.gauge("fed.loss", float("nan"))
+            assert [a["rule"] for a in watch.evaluate_once()] == [
+                "trainer.loss"
+            ]
+            got = ctl.decide_once()
+            decisions += got
+            assert [d["decision"] for d in got] == ["revert.alert"]
+            assert got[0]["revert"] is True
+            assert ctl.deadline_ms == 20.0 and ctl.max_bucket == 4
+            # ...and while it keeps firing, hold still at baseline
+            assert ctl.decide_once() == []
+            assert obs.registry().gauges["tune.alert_backoff"] == 1.0
+
+            # recovery: alert clears, the loop resumes (calm window +
+            # baseline config = no spurious decision), traffic serves
+            obs.gauge("fed.loss", 0.4)
+            watch.evaluate_once()
+            assert watch.active_alerts() == []
+            assert ctl.decide_once() == []
+            with MicroBatcher(engine) as b:
+                b.submit(_rows(1)[0]).result(timeout=30)
+
+            # EXACT reconciliation across every surface
+            reg = obs.registry()
+            assert len(decisions) == 3
+            assert ctl.totals == {"decisions": 3, "reverts": 1}
+            assert reg.counters["tune.decisions"] == 3.0
+            assert reg.counters["tune.reverts"] == 1.0
+            assert reg.gauges["tune.active_deadline_ms"] == 20.0
+            assert reg.gauges["tune.active_max_bucket"] == 4.0
+            assert reg.gauges["tune.alert_backoff"] == 0.0
+            spans = [s for s in reg.spans if s.name == "tune.decide"]
+            assert [s.meta["decision"] for s in spans] == [
+                "buckets.shrink", "deadline.tighten", "revert.alert",
+            ]
+            body = obs_server.render_prometheus()
+            assert "qfedx_tune_decisions 3.0" in body
+            assert "qfedx_tune_reverts 1.0" in body
+            assert "qfedx_tune_active_deadline_ms 20.0" in body
+            assert "qfedx_tune_active_max_bucket 4.0" in body
+
+            # the zero-compile pin held across every decision and every
+            # post-decision flush (the r08 attribution listener)
+            assert _compile_total() == compiled_at_warmup
+        finally:
+            ctl.stop()
+
+    # one decision = one schema-valid {"event": "tune"} row, in order
+    rows = [
+        json.loads(line)
+        for line in (run.dir / "metrics.jsonl").read_text().splitlines()
+        if line.strip()
+    ]
+    tune_rows = [r for r in rows if r.get("event") == "tune"]
+    for r in tune_rows:
+        validate_metrics_record(r)
+    assert [(r["decision"], r["revert"]) for r in tune_rows] == [
+        ("buckets.shrink", False),
+        ("deadline.tighten", False),
+        ("revert.alert", True),
+    ]
+    assert [r["decision"] for r in tune_rows] == [
+        d["decision"] for d in decisions
+    ]
+
+
+def test_relax_and_grow_reopen_the_lattice(monkeypatch):
+    """The recovery directions: a calm window doubles the deadline back
+    toward baseline (never past it) and full batches grow the cap one
+    warmed bucket at a time — then a calm baseline holds still."""
+    monkeypatch.setenv("QFEDX_TUNE", "60")
+    monkeypatch.delenv("QFEDX_WATCH", raising=False)
+    obs.reset()
+    engine = _engine(buckets=(1, 2, 4), deadline_ms=20.0, slo_ms=50.0)
+    ctl = tune.TuneController(engine)
+    assert ctl.decide_once() == []  # counter baseline tick
+
+    # start from a tightened/shrunk active point inside the lattice
+    ctl.deadline_ms = 5.0
+    ctl.max_bucket = 2
+    for _ in range(tune.MIN_WINDOW_COUNT):
+        obs.histogram("serve.latency_ms", 1.0)  # p95 << 0.3*SLO
+    obs.counter("serve.requests_served", 4.0)   # occupancy 2.0 >= 0.9*2
+    obs.counter("serve.batches", 2.0)
+    got = ctl.decide_once()
+    assert [d["decision"] for d in got] == [
+        "deadline.relax", "buckets.grow",
+    ]
+    assert ctl.deadline_ms == 10.0 and ctl.max_bucket == 4
+
+    # a second calm window walks the deadline to baseline, cap is
+    # already at the top bucket: exactly one decision
+    for _ in range(tune.MIN_WINDOW_COUNT):
+        obs.histogram("serve.latency_ms", 1.0)
+    got = ctl.decide_once()
+    assert [d["decision"] for d in got] == ["deadline.relax"]
+    assert ctl.deadline_ms == 20.0
+
+    # at baseline on a calm window: no motion, totals stand
+    for _ in range(tune.MIN_WINDOW_COUNT):
+        obs.histogram("serve.latency_ms", 1.0)
+    assert ctl.decide_once() == []
+    assert ctl.totals == {"decisions": 3, "reverts": 0}
+
+
+# -- the offline half: qfedx tune -> best_config.json -> --tuned ---------------
+
+
+def test_offline_sweep_writes_sidecar_and_apply_respects_operator(
+    tmp_path, monkeypatch
+):
+    """tune_run_dir sweeps the lattice through the REAL serving stack
+    and writes a schema-1 pin sidecar; apply_best_config replays it
+    through utils/pins but never clobbers an operator-set pin."""
+    from qfedx_tpu.tune import offline
+
+    run_dir = _write_run_dir(tmp_path)
+    record = offline.tune_run_dir(
+        run_dir,
+        slo_ms=250.0,
+        bucket_sets=((1, 2), (1, 4)),
+        deadlines_ms=(5.0,),
+        requests=8,
+        rate_fracs=(0.5,),
+    )
+    side = run_dir / "best_config.json"
+    assert side.exists() and record["path"] == str(side)
+    disk = json.loads(side.read_text())
+    assert disk["schema"] == offline.BEST_CONFIG_SCHEMA
+    assert disk["key"]["model"].startswith("vqc")
+    assert disk["key"]["slo_ms"] == 250.0
+    assert disk["key"]["backend"] == jax.default_backend()
+    assert len(disk["cells"]) == 2  # 2 bucket sets x 1 deadline
+    assert set(disk["pins"]) == {
+        "QFEDX_SERVE_BUCKETS", "QFEDX_SERVE_DEADLINE_MS",
+    }
+    assert disk["pins"]["QFEDX_SERVE_DEADLINE_MS"] == "5"
+    assert disk["score"]["metric"] == "throughput_at_slo"
+
+    # restore: the unset pin is applied, the operator-set pin is kept
+    monkeypatch.delenv("QFEDX_SERVE_BUCKETS", raising=False)
+    monkeypatch.setenv("QFEDX_SERVE_DEADLINE_MS", "33")
+    applied = offline.apply_best_config(run_dir)
+    assert applied["applied"] == {
+        "QFEDX_SERVE_BUCKETS": disk["pins"]["QFEDX_SERVE_BUCKETS"],
+    }
+    assert applied["skipped"] == {"QFEDX_SERVE_DEADLINE_MS": "33"}
+    cfg = ServeConfig.resolve()
+    assert cfg.buckets == tuple(
+        int(b) for b in disk["pins"]["QFEDX_SERVE_BUCKETS"].split(",")
+    )
+    assert cfg.deadline_ms == 33.0  # the operator won
+    pins.clear_pin("QFEDX_SERVE_BUCKETS")
+
+    # a torn or foreign sidecar is loud, not silently wrong
+    side.write_text(json.dumps({"schema": 99, "pins": {}}))
+    with pytest.raises(ValueError, match="schema"):
+        offline.load_best_config(side)
+    side.write_text(json.dumps({"schema": 1}))
+    with pytest.raises(ValueError, match="pins"):
+        offline.load_best_config(side)
+
+
+def test_cli_tune_then_serve_tuned_round_trip(tmp_path, monkeypatch):
+    """`qfedx tune` writes the sidecar; bare `qfedx serve --tuned`
+    restores it from the run dir and the resolved config reflects the
+    tuned lattice while answering real requests."""
+    from qfedx_tpu.run.cli import build_parser, run_serve, run_tune
+
+    for pin in ("QFEDX_SERVE_BUCKETS", "QFEDX_SERVE_DEADLINE_MS"):
+        monkeypatch.delenv(pin, raising=False)
+    run_dir = _write_run_dir(tmp_path)
+    args = build_parser().parse_args([
+        "tune", "--run-dir", str(run_dir), "--buckets", "1,2",
+        "--deadlines", "5", "--requests", "8", "--slo-ms", "250",
+    ])
+    record = run_tune(args)
+    assert record["pins"] == {
+        "QFEDX_SERVE_BUCKETS": "1,2",
+        "QFEDX_SERVE_DEADLINE_MS": "5",
+    }
+    assert (run_dir / "best_config.json").exists()
+
+    req = tmp_path / "req.jsonl"
+    out = tmp_path / "resp.jsonl"
+    req.write_text(json.dumps({"id": "a", "features": [0.1] * N}) + "\n")
+    sargs = build_parser().parse_args([
+        "serve", "--run-dir", str(run_dir), "--tuned",
+        "--input", str(req), "--output", str(out),
+    ])
+    summary = run_serve(sargs)
+    assert summary["served"] == 1
+    recs = [json.loads(l) for l in out.read_text().splitlines()]
+    assert recs[0]["id"] == "a" and "pred" in recs[0]
+    # the tuned pins are live in this process (monkeypatch restores env)
+    cfg = ServeConfig.resolve()
+    assert cfg.buckets == (1, 2) and cfg.deadline_ms == 5.0
+
+
+def test_inspect_surfaces_tune_decisions_and_sidecar(tmp_path):
+    """`qfedx inspect` tallies the {"event": "tune"} ledger (per-id
+    counts + reverts) and summarizes the best_config.json sidecar."""
+    from qfedx_tpu.run.cli import run_inspect
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    rows = [
+        {"schema": 1, "round": 0, "ts": 1.0},
+        {"schema": 1, "event": "tune", "ts": 2.0,
+         "decision": "buckets.shrink", "revert": False},
+        {"schema": 1, "event": "tune", "ts": 3.0,
+         "decision": "deadline.tighten", "revert": False},
+        {"schema": 1, "event": "tune", "ts": 4.0,
+         "decision": "revert.alert", "revert": True},
+    ]
+    (run_dir / "metrics.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows)
+    )
+    (run_dir / "best_config.json").write_text(json.dumps({
+        "schema": 1,
+        "key": {"model": "vqc"},
+        "pins": {"QFEDX_SERVE_BUCKETS": "1,2"},
+        "score": {"metric": "throughput_at_slo",
+                  "throughput_at_slo": 12.0},
+        "cells": [{}, {}],
+        "provenance": {"source": "qfedx tune"},
+    }) + "\n")
+    out = run_inspect(run_dir)
+    assert out["tune_decisions"] == {
+        "buckets.shrink": 1, "deadline.tighten": 1, "revert.alert": 1,
+    }
+    assert out["tune_reverts"] == 1
+    assert out["tune"]["pins"] == {"QFEDX_SERVE_BUCKETS": "1,2"}
+    assert out["tune"]["cells"] == 2
